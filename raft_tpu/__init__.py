@@ -1,0 +1,54 @@
+"""raft_tpu — a TPU-native library of ML / vector-search primitives.
+
+A from-scratch JAX/XLA/Pallas re-design with the capabilities of RAPIDS RAFT
+23.04 (reference: cpp/include/raft): dense & sparse linear algebra, pairwise
+distances, batched top-k selection, exact and approximate nearest-neighbor
+indexes (brute-force, IVF-Flat, IVF-PQ, ball-cover), clustering (k-means,
+balanced k-means, single-linkage, spectral), statistics, solvers and a
+multi-device collective communication layer over ICI/DCN meshes.
+
+Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
+
+  core      — Resources registry / device handle, typed array views,
+              serialization, logging, tracing, interruptible
+              (ref: cpp/include/raft/core)
+  util      — small helpers: Pow2 alignment, integer utils
+              (ref: cpp/include/raft/util — the warp/SIMT machinery is
+              replaced by XLA/Pallas, only the host-level utilities survive)
+  linalg    — element-wise ops, reductions, BLAS/LAPACK-level wrappers
+              (ref: cpp/include/raft/linalg)
+  matrix    — matrix ops and batched select_k top-k
+              (ref: cpp/include/raft/matrix)
+  random    — counter-based RNG (RngState), distributions, make_blobs, rmat
+              (ref: cpp/include/raft/random)
+  stats     — descriptive stats + model/cluster quality metrics
+              (ref: cpp/include/raft/stats)
+  distance  — pairwise distances (20 metrics), fused L2 argmin, masked NN,
+              gram/kernel matrices (ref: cpp/include/raft/distance)
+  cluster   — kmeans, balanced hierarchical kmeans, single-linkage
+              (ref: cpp/include/raft/cluster)
+  neighbors — brute-force kNN, IVF-Flat, IVF-PQ, refine, ball-cover,
+              epsilon neighborhood (ref: cpp/include/raft/neighbors)
+  sparse    — COO/CSR formats, ops, sparse distance/knn, MST, Lanczos
+              (ref: cpp/include/raft/sparse)
+  spectral  — spectral partitioning / modularity maximization
+              (ref: cpp/include/raft/spectral)
+  solver    — linear assignment problem (ref: cpp/include/raft/solver)
+  label     — label utilities (ref: cpp/include/raft/label)
+  comms     — comms_t-style collective facade over jax shard_map + lax
+              collectives (ref: cpp/include/raft/comms, raft/core/comms.hpp)
+  parallel  — multi-device (MNMG-analog) algorithms: sharded kNN / kmeans
+              (ref: raft-dask + cuML MNMG patterns)
+  ops       — Pallas TPU kernels for the hot paths (select_k, fused L2 NN,
+              PQ-LUT scan) (ref: hand-tiled CUDA kernels in detail/)
+"""
+
+__version__ = "0.1.0"
+
+from raft_tpu.core.resources import Resources, DeviceResources
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "__version__",
+]

@@ -1,0 +1,64 @@
+"""Core runtime: resources/handle, array views, errors, logging, tracing,
+serialization, operators (ref: cpp/include/raft/core)."""
+
+from raft_tpu.core.resources import (
+    Resources,
+    DeviceResources,
+    resource_factory,
+)
+from raft_tpu.core.error import (
+    RaftError,
+    LogicError,
+    expects,
+    fail,
+)
+from raft_tpu.core.mdarray import (
+    MemoryType,
+    ArraySpec,
+    check_matrix,
+    check_vector,
+    as_array,
+    row_major,
+    col_major,
+)
+from raft_tpu.core.kvp import KeyValuePair
+from raft_tpu.core import operators
+from raft_tpu.core.serialize import (
+    serialize_mdspan,
+    deserialize_mdspan,
+    serialize_scalar,
+    deserialize_scalar,
+)
+from raft_tpu.core.interruptible import Interruptible, synchronize
+from raft_tpu.core.logger import logger, set_level
+from raft_tpu.core.nvtx import range_scope, push_range, pop_range
+
+__all__ = [
+    "Resources",
+    "DeviceResources",
+    "resource_factory",
+    "RaftError",
+    "LogicError",
+    "expects",
+    "fail",
+    "MemoryType",
+    "ArraySpec",
+    "check_matrix",
+    "check_vector",
+    "as_array",
+    "row_major",
+    "col_major",
+    "KeyValuePair",
+    "operators",
+    "serialize_mdspan",
+    "deserialize_mdspan",
+    "serialize_scalar",
+    "deserialize_scalar",
+    "Interruptible",
+    "synchronize",
+    "logger",
+    "set_level",
+    "range_scope",
+    "push_range",
+    "pop_range",
+]

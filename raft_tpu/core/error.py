@@ -1,0 +1,37 @@
+"""Error handling: exception hierarchy + precondition helpers.
+
+TPU-native analog of the reference's ``raft::exception`` /
+``raft::logic_error`` hierarchy and the ``RAFT_EXPECTS`` / ``RAFT_FAIL``
+macros (ref: cpp/include/raft/core/error.hpp:96,168-188). Python exceptions
+carry tracebacks natively so no explicit backtrace collection is needed.
+"""
+
+from __future__ import annotations
+
+
+class RaftError(Exception):
+    """Base exception for raft_tpu (ref: raft::exception, core/error.hpp:96)."""
+
+
+class LogicError(RaftError, ValueError):
+    """Invalid arguments / broken preconditions (ref: raft::logic_error)."""
+
+
+class CudaError(RaftError):
+    """Device-runtime failure. Kept for API parity; on TPU this wraps XLA
+    runtime errors (ref: raft::cuda_error, core/cudart_utils.hpp)."""
+
+
+def expects(cond: bool, msg: str = "precondition violated") -> None:
+    """Precondition check (ref: RAFT_EXPECTS, core/error.hpp:168).
+
+    Raises :class:`LogicError` when ``cond`` is falsy.  Only usable on host
+    (trace-time) values; inside jit use ``checkify``/``jax.debug`` instead.
+    """
+    if not cond:
+        raise LogicError(msg)
+
+
+def fail(msg: str) -> None:
+    """Unconditional failure (ref: RAFT_FAIL, core/error.hpp:188)."""
+    raise LogicError(msg)

@@ -1,0 +1,99 @@
+"""Cooperative cancellation of host threads blocked on device sync.
+
+Ref: ``raft::interruptible`` (cpp/include/raft/core/interruptible.hpp:66-100)
+— a per-thread token registry whose ``synchronize(stream)`` polls for a
+cancellation flag while waiting on the GPU, and ``cancel()`` flips it from
+another thread (pylibraft hooks SIGINT into this,
+python/pylibraft/pylibraft/common/interruptible.pyx).
+
+TPU version: the same token registry; :func:`synchronize` polls the
+cancellation flag while waiting for ``jax.Array``s to become ready on a
+worker thread, raising :class:`InterruptedException` if cancelled.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import jax
+
+from raft_tpu.core.error import RaftError
+
+
+class InterruptedException(RaftError):
+    """Raised inside :func:`synchronize` when the thread's token was
+    cancelled (ref: raft::interruptible::interrupted_exception)."""
+
+
+class Interruptible:
+    """Per-thread cancellation token (ref: interruptible.hpp:66)."""
+
+    _registry: Dict[int, "Interruptible"] = {}
+    _registry_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._cancelled = threading.Event()
+
+    # -- token registry (ref: get_token / get_token(thread_id)) ------------
+    @classmethod
+    def get_token(cls, thread_id: Optional[int] = None) -> "Interruptible":
+        tid = threading.get_ident() if thread_id is None else thread_id
+        with cls._registry_lock:
+            # Sweep tokens of finished threads so a stale cancel() cannot hit
+            # an unrelated future thread that reuses the id, and the registry
+            # stays bounded (ref: interruptible.hpp keeps weak_ptr entries
+            # and drops expired ones).
+            alive = {t.ident for t in threading.enumerate()}
+            alive.add(tid)
+            for dead in [t for t in cls._registry if t not in alive]:
+                del cls._registry[dead]
+            tok = cls._registry.get(tid)
+            if tok is None:
+                tok = cls()
+                cls._registry[tid] = tok
+            return tok
+
+    def cancel(self) -> None:
+        """Request cancellation (ref: interruptible::cancel)."""
+        self._cancelled.set()
+
+    @classmethod
+    def cancel_thread(cls, thread_id: int) -> None:
+        cls.get_token(thread_id).cancel()
+
+    def interruptible_check(self) -> None:
+        """Raise if cancelled, clearing the flag
+        (ref: interruptible::yield_)."""
+        if self._cancelled.is_set():
+            self._cancelled.clear()
+            raise InterruptedException("raft_tpu: thread interrupted")
+
+
+def synchronize(*arrays: jax.Array, poll_interval: float = 0.05) -> None:
+    """Interruptible device sync (ref: interruptible::synchronize(stream),
+    interruptible.hpp:78).
+
+    Blocks until every array is ready, checking the current thread's
+    cancellation token every ``poll_interval`` seconds.
+    """
+    token = Interruptible.get_token()
+    done = threading.Event()
+    err: list = []
+
+    def waiter():
+        try:
+            for a in arrays:
+                jax.block_until_ready(a)
+        except Exception as e:  # pragma: no cover - device failure path
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    while not done.wait(poll_interval):
+        token.interruptible_check()
+    token.interruptible_check()
+    if err:
+        raise err[0]

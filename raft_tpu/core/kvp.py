@@ -1,0 +1,20 @@
+"""Key-value pair carried as a pytree.
+
+Ref: ``raft::KeyValuePair<idx, dist>`` (cpp/include/raft/core/kvp.hpp:31) —
+the result type of fused argmin reductions (fused_l2_nn). As a registered
+pytree it flows through jit/vmap/scan unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+
+
+class KeyValuePair(NamedTuple):
+    """(key, value) pair; ``key`` is typically an index, ``value`` a
+    distance (ref: core/kvp.hpp:31)."""
+
+    key: jax.Array
+    value: jax.Array

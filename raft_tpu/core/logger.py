@@ -1,0 +1,72 @@
+"""Singleton logger with settable level and callback sinks.
+
+TPU-native analog of the spdlog-wrapped ``raft::logger``
+(ref: cpp/include/raft/core/logger.hpp:118-156,
+cpp/include/raft/core/detail/callback_sink.hpp). Built on the stdlib
+``logging`` module; supports a user callback sink + flush hook like the
+reference's Python-callback sink used by pylibraft.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+# Level names mirror the reference's RAFT_LEVEL_* (core/logger.hpp:40-57).
+OFF = logging.CRITICAL + 10
+CRITICAL = logging.CRITICAL
+ERROR = logging.ERROR
+WARN = logging.WARNING
+INFO = logging.INFO
+DEBUG = logging.DEBUG
+TRACE = logging.DEBUG - 5
+
+logging.addLevelName(TRACE, "TRACE")
+
+logger = logging.getLogger("raft_tpu")
+if not logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s"))
+    logger.addHandler(_h)
+    logger.setLevel(WARN)
+
+
+class CallbackSink(logging.Handler):
+    """Route formatted log lines to a Python callable, with optional flush
+    hook (ref: detail/callback_sink.hpp)."""
+
+    def __init__(
+        self,
+        callback: Callable[[int, str], None],
+        flush: Optional[Callable[[], None]] = None,
+    ):
+        super().__init__()
+        self._callback = callback
+        self._flush = flush
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self._callback(record.levelno, self.format(record))
+
+    def flush(self) -> None:
+        if self._flush is not None:
+            self._flush()
+
+
+def set_level(level: int) -> None:
+    """Set the global raft_tpu log level (ref: logger::set_level)."""
+    logger.setLevel(level)
+
+
+def set_callback(
+    callback: Callable[[int, str], None],
+    flush: Optional[Callable[[], None]] = None,
+) -> CallbackSink:
+    """Install a callback sink and return it (remove with
+    ``logger.removeHandler``)."""
+    sink = CallbackSink(callback, flush)
+    logger.addHandler(sink)
+    return sink
+
+
+def trace(msg: str, *args) -> None:
+    logger.log(TRACE, msg, *args)

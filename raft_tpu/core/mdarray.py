@@ -1,0 +1,145 @@
+"""Typed array views and validation.
+
+The reference builds its API on ``mdspan``/``mdarray`` — non-owning multi-d
+views and owning arrays over host/device memory with explicit layouts and a
+``memory_type`` enum (ref: cpp/include/raft/core/mdarray.hpp:126,
+core/mdspan.hpp, core/memory_type.hpp:19, core/host_device_accessor.hpp:34).
+
+On TPU the owning container is simply ``jax.Array`` (XLA manages HBM); what
+survives the re-design is the *typed view* discipline: every public API
+validates dtype / rank / layout / extents up front the way the reference's
+template signatures do at compile time. This module provides that validation
+layer plus ``make_*`` factories mirroring ``make_device_matrix`` et al.
+(ref: core/device_mdarray.hpp:84-174).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+Array = Union[jax.Array, np.ndarray]
+
+
+class MemoryType(enum.Enum):
+    """Memory kinds (ref: raft::memory_type, core/memory_type.hpp:19).
+
+    On TPU, ``device`` = HBM, ``host`` = CPU RAM, ``pinned`` maps to
+    host-pinned staging (XLA handles this internally) and ``managed`` has no
+    analog (kept for enum parity; treated as device).
+    """
+
+    host = 0
+    device = 1
+    managed = 2
+    pinned = 3
+
+
+class Layout(enum.Enum):
+    """Row-/col-major layouts (ref: layout_c_contiguous / layout_f_contiguous
+    in core/mdspan.hpp). XLA arrays are logically row-major; col-major inputs
+    are represented as transposed views at the API boundary."""
+
+    row_major = 0
+    col_major = 1
+
+
+row_major = Layout.row_major
+col_major = Layout.col_major
+
+
+@dataclass(frozen=True)
+class ArraySpec:
+    """A lightweight typed-view contract: dtype + rank (+ optional extents).
+
+    Plays the role of an ``mdspan`` template signature: APIs declare the
+    spec they accept and validate inputs against it.
+    """
+
+    dtype: Optional[jnp.dtype] = None
+    ndim: Optional[int] = None
+    shape: Optional[Tuple[Optional[int], ...]] = None
+
+    def validate(self, x: Array, name: str = "array") -> None:
+        if self.dtype is not None:
+            expects(
+                jnp.dtype(x.dtype) == jnp.dtype(self.dtype),
+                f"{name}: expected dtype {self.dtype}, got {x.dtype}",
+            )
+        if self.ndim is not None:
+            expects(
+                x.ndim == self.ndim,
+                f"{name}: expected rank {self.ndim}, got {x.ndim}",
+            )
+        if self.shape is not None:
+            expects(len(self.shape) == x.ndim, f"{name}: rank mismatch")
+            for i, (want, got) in enumerate(zip(self.shape, x.shape)):
+                if want is not None:
+                    expects(
+                        want == got,
+                        f"{name}: extent {i} expected {want}, got {got}",
+                    )
+
+
+def as_array(x, dtype=None) -> jax.Array:
+    """Ingest any array-like into a jax.Array (zero-copy where possible).
+
+    TPU analog of pylibraft's ``cai_wrapper`` zero-copy CUDA-array-interface
+    ingestion (ref: python/pylibraft/pylibraft/common/cai_wrapper.py:21).
+    """
+    arr = jnp.asarray(x, dtype=dtype)
+    return arr
+
+
+def check_matrix(
+    x: Array,
+    name: str = "matrix",
+    dtype=None,
+    rows: Optional[int] = None,
+    cols: Optional[int] = None,
+) -> jax.Array:
+    """Validate a rank-2 input (ref: device_matrix_view contract)."""
+    arr = as_array(x)
+    ArraySpec(dtype=dtype, ndim=2, shape=(rows, cols)).validate(arr, name)
+    return arr
+
+
+def check_vector(
+    x: Array, name: str = "vector", dtype=None, size: Optional[int] = None
+) -> jax.Array:
+    """Validate a rank-1 input (ref: device_vector_view contract)."""
+    arr = as_array(x)
+    ArraySpec(dtype=dtype, ndim=1, shape=(size,) if size is not None else None).validate(
+        arr, name
+    )
+    return arr
+
+
+def is_row_major(x: Array) -> bool:
+    """Layout probe (ref: util/input_validation.hpp is_row_major). jax.Arrays
+    are always logically row-major; numpy arrays are checked for C order."""
+    if isinstance(x, np.ndarray):
+        return x.flags["C_CONTIGUOUS"] or x.ndim < 2
+    return True
+
+
+# -- factories (ref: make_device_matrix / make_device_vector /
+#    make_device_scalar, core/device_mdarray.hpp:84-174) --------------------
+
+def make_matrix(rows: int, cols: int, dtype=jnp.float32) -> jax.Array:
+    return jnp.zeros((rows, cols), dtype=dtype)
+
+
+def make_vector(size: int, dtype=jnp.float32) -> jax.Array:
+    return jnp.zeros((size,), dtype=dtype)
+
+
+def make_scalar(value=0, dtype=jnp.float32) -> jax.Array:
+    return jnp.asarray(value, dtype=dtype)

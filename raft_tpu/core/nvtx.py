@@ -1,0 +1,45 @@
+"""Scoped profiler ranges in named domains.
+
+TPU-native analog of the reference's NVTX wrappers
+(ref: cpp/include/raft/core/nvtx.hpp:48-90). Maps onto
+``jax.profiler.TraceAnnotation`` so ranges show up in XLA / Perfetto traces
+captured with ``jax.profiler``; the reference's convention — a range at every
+public API entry — is kept throughout raft_tpu.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Iterator, List
+
+import jax
+
+_tls = threading.local()
+
+
+def _stack() -> List[object]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+@contextlib.contextmanager
+def range_scope(name: str, domain: str = "raft_tpu") -> Iterator[None]:
+    """Scoped trace range (ref: common::nvtx::range<domain>, nvtx.hpp:48)."""
+    with jax.profiler.TraceAnnotation(f"{domain}::{name}"):
+        yield
+
+
+def push_range(name: str, domain: str = "raft_tpu") -> None:
+    """Open a trace range (ref: nvtx::push_range). Prefer ``range_scope``."""
+    ann = jax.profiler.TraceAnnotation(f"{domain}::{name}")
+    ann.__enter__()
+    _stack().append(ann)
+
+
+def pop_range() -> None:
+    """Close the innermost trace range (ref: nvtx::pop_range)."""
+    stack = _stack()
+    if stack:
+        stack.pop().__exit__(None, None, None)

@@ -1,0 +1,163 @@
+"""Composable functors used as map / reduce / epilogue operators.
+
+Ref: cpp/include/raft/core/operators.hpp:36-240 — the reference passes these
+structs into kernels as template parameters; here they are plain callables
+passed into :mod:`raft_tpu.linalg` map/reduce primitives, and XLA fuses them
+exactly as the CUDA templates did.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.kvp import KeyValuePair
+
+
+# -- unary ------------------------------------------------------------------
+def identity_op(x, *_):
+    return x
+
+
+def void_op(*_):
+    return None
+
+
+def sq_op(x, *_):
+    return x * x
+
+
+def abs_op(x, *_):
+    return jnp.abs(x)
+
+
+def sqrt_op(x, *_):
+    return jnp.sqrt(x)
+
+
+def nz_op(x, *_):
+    return (x != 0).astype(x.dtype)
+
+
+def cast_op(dtype):
+    def op(x, *_):
+        return x.astype(dtype)
+
+    return op
+
+
+# -- binary -----------------------------------------------------------------
+def add_op(a, b):
+    return a + b
+
+
+def sub_op(a, b):
+    return a - b
+
+
+def mul_op(a, b):
+    return a * b
+
+
+def div_op(a, b):
+    return a / b
+
+
+def div_checkzero_op(a, b):
+    return jnp.where(b == 0, jnp.zeros_like(a * b), a / b)
+
+
+def pow_op(a, b):
+    return jnp.power(a, b)
+
+
+def min_op(a, b):
+    return jnp.minimum(a, b)
+
+
+def max_op(a, b):
+    return jnp.maximum(a, b)
+
+
+def sqdiff_op(a, b):
+    d = a - b
+    return d * d
+
+
+def absdiff_op(a, b):
+    return jnp.abs(a - b)
+
+
+def equal_op(a, b):
+    return a == b
+
+
+def notequal_op(a, b):
+    return a != b
+
+
+# -- key-value reducers (ref: argmin_op/argmax_op on KeyValuePair) ----------
+def argmin_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    take_b = (b.value < a.value) | ((b.value == a.value) & (b.key < a.key))
+    return KeyValuePair(
+        key=jnp.where(take_b, b.key, a.key),
+        value=jnp.where(take_b, b.value, a.value),
+    )
+
+
+def argmax_op(a: KeyValuePair, b: KeyValuePair) -> KeyValuePair:
+    take_b = (b.value > a.value) | ((b.value == a.value) & (b.key < a.key))
+    return KeyValuePair(
+        key=jnp.where(take_b, b.key, a.key),
+        value=jnp.where(take_b, b.value, a.value),
+    )
+
+
+def key_op(kvp: KeyValuePair, *_):
+    return kvp.key
+
+
+def value_op(kvp: KeyValuePair, *_):
+    return kvp.value
+
+
+# -- compose ----------------------------------------------------------------
+def compose_op(*ops):
+    """Apply ops right-to-left: compose_op(f, g)(x) == f(g(x))
+    (ref: compose_op, core/operators.hpp)."""
+
+    def op(x, *args):
+        for f in reversed(ops):
+            x = f(x, *args)
+        return x
+
+    return op
+
+
+def plug_const_op(const, binary):
+    """Bind the second argument of a binary op
+    (ref: plug_const_op, core/operators.hpp)."""
+
+    def op(x, *_):
+        return binary(x, const)
+
+    return op
+
+
+def add_const_op(const):
+    return plug_const_op(const, add_op)
+
+
+def sub_const_op(const):
+    return plug_const_op(const, sub_op)
+
+
+def mul_const_op(const):
+    return plug_const_op(const, mul_op)
+
+
+def div_const_op(const):
+    return plug_const_op(const, div_op)
+
+
+def pow_const_op(const):
+    return plug_const_op(const, pow_op)

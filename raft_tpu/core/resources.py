@@ -1,0 +1,190 @@
+"""Resource registry and device handle.
+
+TPU-native re-design of the reference's two-level handle:
+
+* ``raft::resources`` — a type-erased, lazily-populated container of
+  per-handle resources with factory-registered slots, shallow-copyable
+  (ref: cpp/include/raft/core/resources.hpp:46,
+  cpp/include/raft/core/resource/resource_types.hpp:29-46).
+* ``raft::device_resources`` — the handle passed to every API, carrying the
+  stream, stream pool, BLAS handles, comms and workspace allocator
+  (ref: cpp/include/raft/core/device_resources.hpp:60-232).
+
+On TPU most of those slots dissolve: streams/BLAS handles are XLA's business
+and ordering is data-flow. What remains meaningful is kept with the same
+shape: a lazily-built slot registry holding the target device, the
+``jax.sharding.Mesh`` used for multi-device work, a counter-based PRNG key
+stream, the injected communicator (:mod:`raft_tpu.comms`) and named
+sub-communicators (ref: core/resource/comms.hpp, core/resource/sub_comms.hpp:50).
+``sync_stream``-style synchronization maps to ``block_until_ready``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from raft_tpu.core.error import LogicError, expects
+
+# ---------------------------------------------------------------------------
+# Factory registry (ref: resource factories registered per resource_type,
+# core/resources.hpp:61-76).
+
+_FACTORIES: Dict[str, Callable[["Resources"], Any]] = {}
+
+
+def resource_factory(name: str):
+    """Register a default factory for resource slot ``name``.
+
+    Mirrors the reference's pattern of one ``*_resource_factory`` per slot
+    (ref: cpp/include/raft/core/resource/*.hpp — 15 factory headers).
+    """
+
+    def deco(fn: Callable[["Resources"], Any]):
+        _FACTORIES[name] = fn
+        return fn
+
+    return deco
+
+
+@resource_factory("device")
+def _default_device(res: "Resources"):
+    return jax.devices()[0]
+
+
+@resource_factory("mesh")
+def _default_mesh(res: "Resources"):
+    # Single-device mesh over one axis; multi-device users pass an explicit
+    # Mesh. Axis name convention: "data" (row shards) is the default axis.
+    return jax.sharding.Mesh([res.device], ("data",))
+
+
+@resource_factory("prng_key")
+def _default_prng_key(res: "Resources"):
+    return jax.random.key(0)
+
+
+class Resources:
+    """Lazily-populated resource container (ref: raft::resources,
+    core/resources.hpp:46).
+
+    Slots are created on first access from registered factories; instances
+    are shallow-copyable — copies share already-created slots, like the
+    reference's shallow copy of the resource vector.
+    """
+
+    def __init__(self, other: Optional["Resources"] = None, **overrides):
+        if other is not None:
+            # Shallow copy: already-created resource *objects* are shared,
+            # but the slot table is independent — rebinding a slot on the
+            # copy (e.g. a different device) never mutates the source
+            # (ref: resources copy-ctor copies the vector of shared_ptrs).
+            self._slots = dict(other._slots)
+        else:
+            self._slots = {}
+        for k, v in overrides.items():
+            if v is not None:
+                self._slots[k] = v
+
+    # -- generic slot access (ref: resources::get_resource) ---------------
+    def has_resource(self, name: str) -> bool:
+        return name in self._slots or name in _FACTORIES
+
+    def get_resource(self, name: str) -> Any:
+        if name not in self._slots:
+            if name not in _FACTORIES:
+                raise LogicError(f"no resource or factory registered for '{name}'")
+            self._slots[name] = _FACTORIES[name](self)
+        return self._slots[name]
+
+    def set_resource(self, name: str, value: Any) -> None:
+        self._slots[name] = value
+
+    # -- named accessors mirroring device_resources ------------------------
+    @property
+    def device(self):
+        """Target device (ref: device_id resource, core/resource/device_id.hpp)."""
+        return self.get_resource("device")
+
+    @property
+    def mesh(self) -> jax.sharding.Mesh:
+        """Device mesh for multi-device collectives (TPU analog of the
+        stream-pool + comms clique the reference handle carries)."""
+        return self.get_resource("mesh")
+
+    # -- PRNG key stream ----------------------------------------------------
+    def next_key(self):
+        """Split and return a fresh PRNG key from the handle's key stream."""
+        key = self.get_resource("prng_key")
+        key, sub = jax.random.split(key)
+        self.set_resource("prng_key", key)
+        return sub
+
+    # -- comms (ref: device_resources::get_comms / get_subcomm,
+    #    device_resources.hpp:205-232) --------------------------------------
+    def set_comms(self, comms) -> None:
+        self.set_resource("comms", comms)
+
+    def get_comms(self):
+        expects("comms" in self._slots, "no communicator injected on handle")
+        return self._slots["comms"]
+
+    def comms_initialized(self) -> bool:
+        return "comms" in self._slots
+
+    def set_subcomm(self, key: str, comms) -> None:
+        self._slots.setdefault("sub_comms", {})[key] = comms
+
+    def get_subcomm(self, key: str):
+        subs = self._slots.get("sub_comms", {})
+        expects(key in subs, f"no sub-communicator '{key}' on handle")
+        return subs[key]
+
+    # -- synchronization (ref: device_resources::sync_stream;
+    #    stream_syncer RAII, device_resources.hpp:237) ----------------------
+    def sync_stream(self, *arrays) -> None:
+        """Block until the given arrays (or all pending work) are ready.
+
+        XLA ordering is data-flow based, so with no arguments this is only a
+        barrier for previously-returned arrays the caller still holds; the
+        per-call semantics of the reference's stream sync are preserved by
+        passing the arrays produced by the call.
+        """
+        for a in arrays:
+            jax.block_until_ready(a)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Resources(slots={list(self._slots)})"
+
+
+class DeviceResources(Resources):
+    """Convenience handle mirroring ``raft::device_resources``
+    (ref: core/device_resources.hpp:60) / pylibraft's ``DeviceResources``
+    (ref: python/pylibraft/pylibraft/common/handle.pyx:34).
+
+    ``DeviceResources(device=..., mesh=..., seed=...)`` pins the slots up
+    front; otherwise they are built lazily from the factories.
+    """
+
+    def __init__(self, device=None, mesh=None, seed: Optional[int] = None):
+        super().__init__(
+            device=device,
+            mesh=mesh,
+            prng_key=jax.random.key(seed) if seed is not None else None,
+        )
+
+
+# Legacy alias (ref: raft::handle_t, core/handle.hpp).
+Handle = DeviceResources
+
+
+def ensure_handle(handle: Optional[Resources]) -> Resources:
+    """Create a default handle when the caller passed none.
+
+    Mirrors pylibraft's ``@auto_sync_handle`` decorator behavior of
+    auto-creating a handle per call (ref: common/handle.pyx:209); sync is
+    implicit in JAX's data-flow ordering.
+    """
+    return handle if handle is not None else DeviceResources()

@@ -1,0 +1,55 @@
+"""Binary serialization of arrays and scalars to streams.
+
+Ref: ``raft::serialize_mdspan`` writes mdspans as NumPy ``.npy`` payloads
+into a binary stream, plus raw little-endian scalars
+(cpp/include/raft/core/serialize.hpp:34,
+core/detail/mdspan_numpy_serializer.hpp). We keep the exact same wire
+convention — ``.npy`` per array, packed scalars — so indexes serialized by
+raft_tpu are plain NumPy containers, interoperable with the reference's
+format at the payload level.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Union
+
+import jax
+import numpy as np
+
+_SCALAR_FMT = {
+    np.dtype(np.int8): "<b",
+    np.dtype(np.uint8): "<B",
+    np.dtype(np.int32): "<i",
+    np.dtype(np.uint32): "<I",
+    np.dtype(np.int64): "<q",
+    np.dtype(np.uint64): "<Q",
+    np.dtype(np.float32): "<f",
+    np.dtype(np.float64): "<d",
+    np.dtype(np.bool_): "<?",
+}
+
+
+def serialize_mdspan(stream: BinaryIO, arr: Union[jax.Array, np.ndarray]) -> None:
+    """Write an array to ``stream`` as an ``.npy`` payload
+    (ref: serialize_mdspan, core/serialize.hpp:34)."""
+    np.save(stream, np.asarray(arr), allow_pickle=False)
+
+
+def deserialize_mdspan(stream: BinaryIO) -> np.ndarray:
+    """Read an ``.npy`` payload (ref: deserialize_mdspan)."""
+    return np.load(stream, allow_pickle=False)
+
+
+def serialize_scalar(stream: BinaryIO, value, dtype) -> None:
+    """Write a raw little-endian scalar (ref: serialize_scalar)."""
+    dt = np.dtype(dtype)
+    stream.write(struct.pack(_SCALAR_FMT[dt], dt.type(value).item()))
+
+
+def deserialize_scalar(stream: BinaryIO, dtype):
+    """Read a raw little-endian scalar (ref: deserialize_scalar)."""
+    dt = np.dtype(dtype)
+    fmt = _SCALAR_FMT[dt]
+    return dt.type(struct.unpack(fmt, stream.read(struct.calcsize(fmt)))[0])

@@ -1,0 +1,76 @@
+"""Dense linear algebra primitives (ref: cpp/include/raft/linalg).
+
+The reference's hand-tiled register/smem contraction engine
+(``Contractions_NT``, linalg/detail/contractions.cuh:26-317) is replaced
+wholesale by XLA ``dot_general`` on the MXU; element-wise ops and reductions
+are expressed functionally and fused by XLA the way the CUDA kernels fused
+epilogues.
+"""
+
+from raft_tpu.linalg.elementwise import (
+    add,
+    add_scalar,
+    subtract,
+    subtract_scalar,
+    multiply,
+    multiply_scalar,
+    divide,
+    divide_scalar,
+    power,
+    power_scalar,
+    sqrt,
+    eltwise,
+    unary_op,
+    binary_op,
+    ternary_op,
+    map,
+    map_offset,
+)
+from raft_tpu.linalg.reduce import (
+    reduce,
+    coalesced_reduction,
+    strided_reduction,
+    map_reduce,
+    reduce_rows_by_key,
+    reduce_cols_by_key,
+    mean_squared_error,
+)
+from raft_tpu.linalg.norm import (
+    NormType,
+    L1Norm,
+    L2Norm,
+    LinfNorm,
+    norm,
+    row_norm,
+    col_norm,
+    normalize,
+)
+from raft_tpu.linalg.blas import gemm, gemv, dot, axpy, transpose
+from raft_tpu.linalg.matrix_vector import matrix_vector_op
+from raft_tpu.linalg.decomp import (
+    qr_get_q,
+    qr_get_qr,
+    eig_dc,
+    eig_jacobi,
+    svd_qr,
+    svd_eig,
+    rsvd,
+    lstsq_svd,
+    lstsq_eig,
+    cholesky_rank_one_update,
+)
+
+__all__ = [
+    "add", "add_scalar", "subtract", "subtract_scalar", "multiply",
+    "multiply_scalar", "divide", "divide_scalar", "power", "power_scalar",
+    "sqrt", "eltwise", "unary_op", "binary_op", "ternary_op", "map",
+    "map_offset",
+    "reduce", "coalesced_reduction", "strided_reduction", "map_reduce",
+    "reduce_rows_by_key", "reduce_cols_by_key", "mean_squared_error",
+    "NormType", "L1Norm", "L2Norm", "LinfNorm", "norm", "row_norm",
+    "col_norm", "normalize",
+    "gemm", "gemv", "dot", "axpy", "transpose",
+    "matrix_vector_op",
+    "qr_get_q", "qr_get_qr", "eig_dc", "eig_jacobi", "svd_qr", "svd_eig",
+    "rsvd", "lstsq_svd", "lstsq_eig", "cholesky_rank_one_update",
+]

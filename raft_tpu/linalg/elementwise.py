@@ -1,0 +1,102 @@
+"""Element-wise operations.
+
+Ref: one header per op under cpp/include/raft/linalg — add.cuh,
+subtract.cuh, multiply.cuh, divide.cuh, power.cuh, sqrt.cuh, eltwise.cuh,
+unary_op.cuh, binary_op.cuh, ternary_op.cuh, map.cuh, map_offset (map.cuh).
+All trivially XLA-fusable; provided for API parity and as the composition
+points the reference exposes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def add(a, b):
+    """Element-wise sum (ref: linalg/add.cuh)."""
+    return jnp.add(as_array(a), as_array(b))
+
+
+def add_scalar(a, scalar):
+    return as_array(a) + scalar
+
+
+def subtract(a, b):
+    """Ref: linalg/subtract.cuh."""
+    return jnp.subtract(as_array(a), as_array(b))
+
+
+def subtract_scalar(a, scalar):
+    return as_array(a) - scalar
+
+
+def multiply(a, b):
+    """Ref: linalg/multiply.cuh."""
+    return jnp.multiply(as_array(a), as_array(b))
+
+
+def multiply_scalar(a, scalar):
+    return as_array(a) * scalar
+
+
+def divide(a, b):
+    """Ref: linalg/divide.cuh."""
+    return jnp.divide(as_array(a), as_array(b))
+
+
+def divide_scalar(a, scalar):
+    return as_array(a) / scalar
+
+
+def power(a, b):
+    """Ref: linalg/power.cuh."""
+    return jnp.power(as_array(a), as_array(b))
+
+
+def power_scalar(a, scalar):
+    return jnp.power(as_array(a), scalar)
+
+
+def sqrt(a):
+    """Ref: linalg/sqrt.cuh."""
+    return jnp.sqrt(as_array(a))
+
+
+def eltwise(op: Callable, *arrays):
+    """Generic element-wise op over n arrays (ref: linalg/eltwise.cuh)."""
+    return op(*(as_array(a) for a in arrays))
+
+
+def unary_op(x, op: Callable):
+    """Ref: linalg/unary_op.cuh unaryOp."""
+    return op(as_array(x))
+
+
+def binary_op(a, b, op: Callable):
+    """Ref: linalg/binary_op.cuh binaryOp."""
+    return op(as_array(a), as_array(b))
+
+
+def ternary_op(a, b, c, op: Callable):
+    """Ref: linalg/ternary_op.cuh ternaryOp."""
+    return op(as_array(a), as_array(b), as_array(c))
+
+
+def map(op: Callable, *arrays):
+    """Map an n-ary op over arrays (ref: linalg/map.cuh raft::linalg::map)."""
+    return op(*(as_array(a) for a in arrays))
+
+
+def map_offset(shape, op: Callable, *arrays):
+    """Map receiving the flat element offset as first argument
+    (ref: linalg/map.cuh map_offset)."""
+    size = 1
+    for s in shape:
+        size *= s
+    idx = jnp.arange(size).reshape(shape)
+    return op(idx, *(as_array(a) for a in arrays))

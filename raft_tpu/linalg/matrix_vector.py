@@ -1,0 +1,35 @@
+"""Matrix ⊙ broadcast-vector operations.
+
+Ref: cpp/include/raft/linalg/matrix_vector_op.cuh — apply a binary (or
+ternary) op between each matrix row/column and a vector. On TPU this is a
+plain broadcast that XLA fuses into neighboring ops.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def matrix_vector_op(
+    matrix,
+    vec,
+    op: Callable,
+    along_rows: bool = True,
+    vec2=None,
+):
+    """Apply ``op(matrix_element, vec_element[, vec2_element])`` broadcasting
+    ``vec`` along rows (True: vec indexed by column id, length n_cols) or
+    columns (ref: matrix_vector_op.cuh matrixVectorOp; bcastAlongRows).
+    """
+    m = as_array(matrix)
+    v = as_array(vec)
+    v = v[None, :] if along_rows else v[:, None]
+    if vec2 is None:
+        return op(m, v)
+    v2 = as_array(vec2)
+    v2 = v2[None, :] if along_rows else v2[:, None]
+    return op(m, v, v2)

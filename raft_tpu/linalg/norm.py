@@ -1,0 +1,69 @@
+"""Row/column norms and normalization.
+
+Ref: cpp/include/raft/linalg/norm.cuh (NormType {L1Norm, L2Norm, LinfNorm},
+rowNorm/colNorm with optional fin_op) and linalg/normalize.cuh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import jax.numpy as jnp
+
+from raft_tpu.core import operators as ops
+from raft_tpu.core.mdarray import as_array
+
+
+class NormType(enum.Enum):
+    """Ref: raft::linalg::NormType (norm_types.hpp)."""
+
+    L1Norm = 0
+    L2Norm = 1
+    LinfNorm = 2
+
+
+L1Norm = NormType.L1Norm
+L2Norm = NormType.L2Norm
+LinfNorm = NormType.LinfNorm
+
+
+def norm(x, norm_type: NormType = L2Norm, axis: int = 1,
+         fin_op: Callable = ops.identity_op):
+    """Norm along an axis. Note: like the reference, L2Norm produces the
+    *squared* L2 norm unless a sqrt fin_op is supplied
+    (ref: linalg/norm.cuh rowNorm — callers pass raft::sqrt_op for true L2).
+    """
+    x = as_array(x)
+    if norm_type == NormType.L1Norm:
+        r = jnp.sum(jnp.abs(x), axis=axis)
+    elif norm_type == NormType.L2Norm:
+        r = jnp.sum(x * x, axis=axis)
+    elif norm_type == NormType.LinfNorm:
+        r = jnp.max(jnp.abs(x), axis=axis)
+    else:  # pragma: no cover
+        raise ValueError(f"unknown norm type {norm_type}")
+    return fin_op(r)
+
+
+def row_norm(x, norm_type: NormType = L2Norm, fin_op: Callable = ops.identity_op):
+    """Per-row norm (ref: linalg/norm.cuh rowNorm)."""
+    return norm(x, norm_type, axis=1, fin_op=fin_op)
+
+
+def col_norm(x, norm_type: NormType = L2Norm, fin_op: Callable = ops.identity_op):
+    """Per-column norm (ref: linalg/norm.cuh colNorm)."""
+    return norm(x, norm_type, axis=0, fin_op=fin_op)
+
+
+def normalize(x, norm_type: NormType = L2Norm, eps: float = 1e-8):
+    """Row-normalize a matrix (ref: linalg/normalize.cuh row_normalize).
+
+    L2 normalization divides by the true (sqrt'd) L2 norm, matching the
+    reference's ``row_normalize(..., L2Norm)`` semantics.
+    """
+    x = as_array(x)
+    fin = ops.sqrt_op if norm_type == NormType.L2Norm else ops.identity_op
+    n = norm(x, norm_type, axis=1, fin_op=fin)
+    n = jnp.where(n < eps, jnp.ones_like(n), n)
+    return x / n[:, None]

@@ -1,0 +1,109 @@
+"""Reductions over rows/columns and keyed reductions.
+
+Ref: cpp/include/raft/linalg/{reduce.cuh, coalesced_reduction.cuh,
+strided_reduction.cuh, map_then_reduce.cuh, reduce_rows_by_key.cuh,
+reduce_cols_by_key.cuh, mean_squared_error.cuh}.
+
+The reference distinguishes coalesced vs strided reductions purely for
+memory-access reasons; on TPU both lower to the same XLA reduce with the
+layout chosen by the compiler, so they share one implementation here.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core import operators as ops
+from raft_tpu.core.mdarray import as_array
+
+
+def reduce(
+    x,
+    axis: int = 1,
+    main_op: Callable = ops.identity_op,
+    reduce_op: Callable = ops.add_op,
+    final_op: Callable = ops.identity_op,
+    init=None,
+):
+    """General map→reduce→finalize along an axis
+    (ref: linalg/reduce.cuh raft::linalg::reduce; along_rows==axis 1).
+
+    ``init`` participates in the accumulation like the reference's init
+    value; ``None`` means the op's identity (no effect).
+    """
+    x = as_array(x)
+    mapped = main_op(x)
+    if reduce_op is ops.add_op:
+        red = jnp.sum(mapped, axis=axis)
+        if init is not None:
+            red = red + jnp.asarray(init, mapped.dtype)
+    elif reduce_op is ops.min_op:
+        red = jnp.min(mapped, axis=axis)
+        if init is not None:
+            red = jnp.minimum(red, jnp.asarray(init, mapped.dtype))
+    elif reduce_op is ops.max_op:
+        red = jnp.max(mapped, axis=axis)
+        if init is not None:
+            red = jnp.maximum(red, jnp.asarray(init, mapped.dtype))
+    else:
+        init_arr = jnp.full((), 0 if init is None else init, dtype=mapped.dtype)
+        red = jax.lax.reduce(mapped, init_arr, reduce_op, (axis,))
+    return final_op(red)
+
+
+def coalesced_reduction(x, **kwargs):
+    """Reduce along the contiguous (last) dimension
+    (ref: linalg/coalesced_reduction.cuh)."""
+    return reduce(x, axis=-1, **kwargs)
+
+
+def strided_reduction(x, **kwargs):
+    """Reduce along the strided (first) dimension
+    (ref: linalg/strided_reduction.cuh)."""
+    return reduce(x, axis=0, **kwargs)
+
+
+def map_reduce(op: Callable, reduce_op: Callable, *arrays, init=0):
+    """Fused map over n arrays then full reduction
+    (ref: linalg/map_reduce.cuh / map_then_reduce.cuh)."""
+    mapped = op(*(as_array(a) for a in arrays))
+    flat = mapped.reshape(-1)
+    init_arr = jnp.full((), init, dtype=flat.dtype)
+    return jax.lax.reduce(flat, init_arr, reduce_op, (0,))
+
+
+def reduce_rows_by_key(
+    x,
+    keys,
+    n_keys: int,
+    weights=None,
+):
+    """Sum rows of ``x`` grouped by per-row key → (n_keys, n_cols).
+
+    Ref: linalg/reduce_rows_by_key.cuh — the k-means centroid-update
+    workhorse. TPU-native: a segment-sum, which XLA lowers to a one-hot
+    matmul / scatter-add on the MXU rather than atomics.
+    """
+    x = as_array(x)
+    keys = as_array(keys).astype(jnp.int32)
+    if weights is not None:
+        x = x * as_array(weights)[:, None]
+    return jax.ops.segment_sum(x, keys, num_segments=n_keys)
+
+
+def reduce_cols_by_key(x, keys, n_keys: int):
+    """Sum columns of ``x`` grouped by per-column key → (n_rows, n_keys)
+    (ref: linalg/reduce_cols_by_key.cuh)."""
+    x = as_array(x)
+    keys = as_array(keys).astype(jnp.int32)
+    return jax.ops.segment_sum(x.T, keys, num_segments=n_keys).T
+
+
+def mean_squared_error(a, b, weight: float = 1.0):
+    """Weighted MSE between two arrays (ref: linalg/mean_squared_error.cuh)."""
+    a, b = as_array(a), as_array(b)
+    d = a - b
+    return weight * jnp.mean(d * d)

@@ -1,0 +1,25 @@
+"""Matrix operations + batched top-k selection (ref: cpp/include/raft/matrix)."""
+
+from raft_tpu.matrix.ops import (
+    argmax,
+    argmin,
+    gather,
+    gather_if,
+    scatter,
+    slice as slice_,
+    copy,
+    init,
+    reverse,
+    sign_flip,
+    linewise_op,
+    col_wise_sort,
+    triangular_upper,
+    shift_fill,
+)
+from raft_tpu.matrix.select_k import select_k, SelectMethod
+
+__all__ = [
+    "argmax", "argmin", "gather", "gather_if", "scatter", "slice_", "copy",
+    "init", "reverse", "sign_flip", "linewise_op", "col_wise_sort",
+    "triangular_upper", "shift_fill", "select_k", "SelectMethod",
+]

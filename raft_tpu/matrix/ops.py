@@ -1,0 +1,122 @@
+"""Matrix utilities.
+
+Ref: cpp/include/raft/matrix/{argmax.cuh, argmin.cuh, gather.cuh,
+slice.cuh, copy.cuh, init.cuh, reverse.cuh, sign_flip.cuh, linewise_op.cuh,
+col_wise_sort.cuh, triangular.cuh} and matrix/detail/*.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def argmax(x, axis: int = 1):
+    """Per-row argmax (ref: matrix/argmax.cuh)."""
+    return jnp.argmax(as_array(x), axis=axis).astype(jnp.int32)
+
+
+def argmin(x, axis: int = 1):
+    """Per-row argmin (ref: matrix/argmin.cuh)."""
+    return jnp.argmin(as_array(x), axis=axis).astype(jnp.int32)
+
+
+def gather(matrix, indices, map_transform: Optional[Callable] = None):
+    """Gather rows by index map (ref: matrix/gather.cuh raft::matrix::gather;
+    map transform variant = gather with a transform_op on indices).
+
+    TPU note: XLA lowers row-gather to efficient dynamic-slice/one-hot
+    forms; for hot paths prefer contiguous batches.
+    """
+    m = as_array(matrix)
+    idx = as_array(indices).astype(jnp.int32)
+    if map_transform is not None:
+        idx = map_transform(idx)
+    return jnp.take(m, idx, axis=0)
+
+
+def gather_if(matrix, indices, stencil, pred_op: Callable, fallback=0.0):
+    """Conditional gather: rows where pred_op(stencil) holds, else fallback
+    (ref: matrix/gather.cuh gather_if)."""
+    m = as_array(matrix)
+    idx = as_array(indices).astype(jnp.int32)
+    mask = pred_op(as_array(stencil))
+    rows = jnp.take(m, idx, axis=0)
+    return jnp.where(mask[:, None], rows, jnp.asarray(fallback, dtype=m.dtype))
+
+
+def scatter(matrix, indices, rows):
+    """Scatter rows into matrix at indices (ref: matrix/scatter.cuh)."""
+    return as_array(matrix).at[as_array(indices).astype(jnp.int32)].set(as_array(rows))
+
+
+def slice(matrix, row0: int, col0: int, row1: int, col1: int):
+    """Submatrix [row0,row1)×[col0,col1) (ref: matrix/slice.cuh)."""
+    return as_array(matrix)[row0:row1, col0:col1]
+
+
+def copy(matrix):
+    """Materialized copy (ref: matrix/copy.cuh)."""
+    return jnp.array(as_array(matrix))
+
+
+def init(shape, value, dtype=jnp.float32):
+    """Constant-filled matrix (ref: matrix/init.cuh)."""
+    return jnp.full(shape, value, dtype=dtype)
+
+
+def reverse(matrix, along_rows: bool = True):
+    """Reverse rows or columns (ref: matrix/reverse.cuh col_reverse/row_reverse)."""
+    m = as_array(matrix)
+    return m[:, ::-1] if along_rows else m[::-1, :]
+
+
+def sign_flip(matrix):
+    """Flip column signs so the max-|value| entry of each column is positive
+    (ref: matrix/sign_flip — used to canonicalize eigenvectors)."""
+    m = as_array(matrix)
+    idx = jnp.argmax(jnp.abs(m), axis=0)
+    signs = jnp.sign(m[idx, jnp.arange(m.shape[1])])
+    signs = jnp.where(signs == 0, 1.0, signs)
+    return m * signs[None, :]
+
+
+def linewise_op(matrix, vecs, op: Callable, along_lines: bool = True):
+    """Apply op between every row (or column) and vector(s)
+    (ref: matrix/linewise_op.cuh raft::matrix::linewise_op)."""
+    m = as_array(matrix)
+    if not isinstance(vecs, (list, tuple)):
+        vecs = (vecs,)
+    vs = [as_array(v)[None, :] if along_lines else as_array(v)[:, None] for v in vecs]
+    return op(m, *vs)
+
+
+def col_wise_sort(matrix, return_indices: bool = False):
+    """Sort each column ascending (ref: matrix/col_wise_sort.cuh
+    sort_cols_per_row operates row-wise on keys; we expose the column-major
+    semantic of detail/columnWiseSort.cuh)."""
+    m = as_array(matrix)
+    if return_indices:
+        idx = jnp.argsort(m, axis=0).astype(jnp.int32)
+        return jnp.sort(m, axis=0), idx
+    return jnp.sort(m, axis=0)
+
+
+def triangular_upper(matrix):
+    """Upper-triangular part (ref: matrix/triangular.cuh upper_triangular)."""
+    return jnp.triu(as_array(matrix))
+
+
+def shift_fill(matrix, k: int, fill_value=0.0):
+    """Shift columns by k (positive: right, negative: left), filling vacated
+    columns with a constant — used by knn merge paths (ref: matrix/shift.cuh)."""
+    m = as_array(matrix)
+    n = m.shape[1]
+    shifted = jnp.roll(m, k, axis=1)
+    col = jnp.arange(n)[None, :]
+    vacated = col < k if k >= 0 else col >= n + k
+    return jnp.where(vacated, jnp.asarray(fill_value, m.dtype), shifted)

@@ -1,0 +1,54 @@
+"""Gaussian-cluster dataset generator.
+
+Ref: ``raft::random::make_blobs`` (cpp/include/raft/random/make_blobs.cuh:63,131)
+— isotropic gaussian blobs around sampled or given centers, with per-feature
+or scalar cluster_std, shuffle, and center box. Used by every quickstart,
+test and benchmark in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.random.rng_state import RngState
+
+
+def make_blobs(
+    n_rows: int,
+    n_cols: int,
+    n_clusters: int = 5,
+    cluster_std: float = 1.0,
+    centers: Optional[jax.Array] = None,
+    center_box_min: float = -10.0,
+    center_box_max: float = 10.0,
+    shuffle: bool = True,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate (data (n_rows, n_cols), labels (n_rows,) int32)
+    (ref: make_blobs.cuh:63)."""
+    state = RngState(seed)
+    if centers is None:
+        centers = jax.random.uniform(
+            state.next_key(),
+            (n_clusters, n_cols),
+            dtype=dtype,
+            minval=center_box_min,
+            maxval=center_box_max,
+        )
+    else:
+        centers = jnp.asarray(centers, dtype=dtype)
+        n_clusters = centers.shape[0]
+    # Balanced assignment then optional shuffle — the reference assigns
+    # row i to cluster i % n_clusters before shuffling.
+    labels = jnp.arange(n_rows, dtype=jnp.int32) % n_clusters
+    if shuffle:
+        labels = jax.random.permutation(state.next_key(), labels)
+    noise = cluster_std * jax.random.normal(
+        state.next_key(), (n_rows, n_cols), dtype=dtype
+    )
+    data = jnp.take(centers, labels, axis=0) + noise
+    return data, labels

@@ -1,0 +1,52 @@
+"""Regression dataset generator.
+
+Ref: ``raft::random::make_regression``
+(cpp/include/raft/random/make_regression.cuh) — random design matrix with a
+low-rank informative structure, ground-truth coefficients, optional bias,
+noise and shuffle (mirrors sklearn's make_regression like the reference
+does).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.linalg.decomp import rsvd  # noqa: F401  (parity: effective_rank path uses svd)
+from raft_tpu.random.rng_state import RngState
+
+
+def make_regression(
+    n_rows: int,
+    n_cols: int,
+    n_informative: Optional[int] = None,
+    n_targets: int = 1,
+    bias: float = 0.0,
+    noise: float = 0.0,
+    shuffle: bool = True,
+    seed: int = 0,
+    dtype=jnp.float32,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (X (n_rows,n_cols), y (n_rows,n_targets), coef
+    (n_cols,n_targets)) (ref: make_regression.cuh make_regression)."""
+    if n_informative is None:
+        n_informative = n_cols
+    n_informative = min(n_informative, n_cols)
+    state = RngState(seed)
+    x = jax.random.normal(state.next_key(), (n_rows, n_cols), dtype=dtype)
+    coef = jnp.zeros((n_cols, n_targets), dtype=dtype)
+    informative = 100.0 * jax.random.uniform(
+        state.next_key(), (n_informative, n_targets), dtype=dtype
+    )
+    coef = coef.at[:n_informative, :].set(informative)
+    if shuffle:
+        perm = jax.random.permutation(state.next_key(), n_cols)
+        coef = jnp.take(coef, perm, axis=0)
+        # x columns stay iid gaussian — permuting them is a no-op in
+        # distribution, so only the coefficient layout is shuffled.
+    y = jnp.matmul(x, coef, precision="highest") + bias
+    if noise > 0:
+        y = y + noise * jax.random.normal(state.next_key(), y.shape, dtype=dtype)
+    return x, y, coef

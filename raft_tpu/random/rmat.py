@@ -1,0 +1,59 @@
+"""R-MAT recursive random graph generator.
+
+Ref: ``raft::random::rmat_rectangular_gen``
+(cpp/include/raft/random/rmat_rectangular_generator.cuh; exposed to Python
+via cpp/src/random/rmat_rectangular_generator_*.cu and
+pylibraft.random.rmat). Generates edges of a power-law graph by recursively
+descending a 2^r_scale × 2^c_scale adjacency matrix with quadrant
+probabilities theta = (a, b, c, d) per level.
+
+TPU-native: all edges descend all levels in parallel — one vectorized
+uniform draw per level (a (n_edges, depth) tensor) instead of the
+reference's per-thread loop; identical distribution.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.random.rng_state import RngState
+
+
+def rmat_rectangular_gen(
+    state: RngState,
+    theta,
+    r_scale: int,
+    c_scale: int,
+    n_edges: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generate (src (n_edges,), dst (n_edges,)) int32 edge lists.
+
+    ``theta`` is either a length-4 (a,b,c,d) prob vector reused at every
+    level, or a (max(r_scale,c_scale), 4) per-level matrix — both forms the
+    reference accepts (rmat_rectangular_generator.cuh docs).
+    """
+    theta = jnp.asarray(theta, jnp.float32).reshape(-1, 4)
+    depth = max(r_scale, c_scale)
+    if theta.shape[0] == 1:
+        theta = jnp.tile(theta, (depth, 1))
+    expects(theta.shape[0] >= depth, "theta must provide max(r_scale,c_scale) levels")
+    theta = theta / theta.sum(axis=1, keepdims=True)
+
+    u = jax.random.uniform(state.next_key(), (n_edges, depth))
+    # Per level: quadrant = searchsorted(cumsum(theta_level), u).
+    cum = jnp.cumsum(theta, axis=1)  # (depth, 4)
+    quad = (u[:, :, None] > cum[None, :, :3]).sum(axis=2)  # (n_edges, depth) ∈ {0..3}
+    r_bit = quad >> 1  # row bit: quadrants c(2), d(3)
+    c_bit = quad & 1   # col bit: quadrants b(1), d(3)
+    # A level contributes a row bit only while within r_scale levels
+    # (rectangular adjacency), same for columns.
+    lvl = jnp.arange(depth)
+    r_w = jnp.where(lvl < r_scale, 1 << (r_scale - 1 - jnp.clip(lvl, 0, r_scale - 1)), 0)
+    c_w = jnp.where(lvl < c_scale, 1 << (c_scale - 1 - jnp.clip(lvl, 0, c_scale - 1)), 0)
+    src = (r_bit * r_w[None, :]).sum(axis=1).astype(jnp.int32)
+    dst = (c_bit * c_w[None, :]).sum(axis=1).astype(jnp.int32)
+    return src, dst

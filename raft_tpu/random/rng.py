@@ -1,0 +1,168 @@
+"""Distribution sampling ops over RngState.
+
+Ref: cpp/include/raft/random/rng.cuh — uniform:44, uniformInt, normal:141,
+normalInt, lognormal, laplace, gumbel, logistic, exponential, rayleigh,
+bernoulli, scaled_bernoulli, discrete, rng_fill, sample_without_replacement,
+permute; multi_variable_gaussian (random/multi_variable_gaussian.cuh).
+Device implementations in random/detail/rng_device.cuh are replaced by
+jax.random's counter-based primitives; inverse-CDF transforms (laplace,
+gumbel, logistic, rayleigh) mirror the reference's custom_distribution
+kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.random.rng_state import RngState
+
+Shape = Union[int, Tuple[int, ...]]
+
+
+def _shape(shape: Shape) -> Tuple[int, ...]:
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+def uniform(state: RngState, shape: Shape, low=0.0, high=1.0, dtype=jnp.float32):
+    """U[low, high) (ref: rng.cuh uniform:44)."""
+    return jax.random.uniform(
+        state.next_key(), _shape(shape), dtype=dtype, minval=low, maxval=high
+    )
+
+
+def uniformInt(state: RngState, shape: Shape, low, high, dtype=jnp.int32):
+    """Integers in [low, high) (ref: rng.cuh uniformInt)."""
+    return jax.random.randint(state.next_key(), _shape(shape), low, high, dtype=dtype)
+
+
+def normal(state: RngState, shape: Shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    """N(mu, sigma²) (ref: rng.cuh normal:141)."""
+    return mu + sigma * jax.random.normal(state.next_key(), _shape(shape), dtype=dtype)
+
+
+def normalInt(state: RngState, shape: Shape, mu, sigma, dtype=jnp.int32):
+    """Rounded normal (ref: rng.cuh normalInt)."""
+    samples = mu + sigma * jax.random.normal(state.next_key(), _shape(shape))
+    return jnp.round(samples).astype(dtype)
+
+
+def lognormal(state: RngState, shape: Shape, mu=0.0, sigma=1.0, dtype=jnp.float32):
+    """exp(N(mu, sigma²)) (ref: rng.cuh lognormal)."""
+    return jnp.exp(normal(state, shape, mu, sigma, dtype))
+
+
+def laplace(state: RngState, shape: Shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    """Laplace(mu, scale) (ref: rng.cuh laplace)."""
+    return mu + scale * jax.random.laplace(state.next_key(), _shape(shape), dtype=dtype)
+
+
+def gumbel(state: RngState, shape: Shape, mu=0.0, beta=1.0, dtype=jnp.float32):
+    """Gumbel(mu, beta) (ref: rng.cuh gumbel)."""
+    return mu + beta * jax.random.gumbel(state.next_key(), _shape(shape), dtype=dtype)
+
+
+def logistic(state: RngState, shape: Shape, mu=0.0, scale=1.0, dtype=jnp.float32):
+    """Logistic(mu, scale) (ref: rng.cuh logistic)."""
+    return mu + scale * jax.random.logistic(state.next_key(), _shape(shape), dtype=dtype)
+
+
+def exponential(state: RngState, shape: Shape, lam=1.0, dtype=jnp.float32):
+    """Exponential with rate lam (ref: rng.cuh exponential)."""
+    return jax.random.exponential(state.next_key(), _shape(shape), dtype=dtype) / lam
+
+
+def rayleigh(state: RngState, shape: Shape, sigma=1.0, dtype=jnp.float32):
+    """Rayleigh(sigma) via inverse CDF (ref: rng.cuh rayleigh)."""
+    u = jax.random.uniform(state.next_key(), _shape(shape), dtype=dtype,
+                           minval=jnp.finfo(dtype).tiny, maxval=1.0)
+    return sigma * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+def bernoulli(state: RngState, shape: Shape, prob=0.5):
+    """Bernoulli(prob) as bool (ref: rng.cuh bernoulli)."""
+    return jax.random.bernoulli(state.next_key(), prob, _shape(shape))
+
+
+def scaled_bernoulli(state: RngState, shape: Shape, prob=0.5, scale=1.0,
+                     dtype=jnp.float32):
+    """±scale with P(+)=1-prob — matches the reference's scaled_bernoulli
+    semantics of val = u > prob ? -scale : scale (ref: rng.cuh
+    scaled_bernoulli, detail/rng_device.cuh ScaledBernoulliDistParams)."""
+    u = jax.random.uniform(state.next_key(), _shape(shape), dtype=dtype)
+    return jnp.where(u > prob, -scale, scale).astype(dtype)
+
+
+def discrete(state: RngState, shape: Shape, weights, dtype=jnp.int32):
+    """Sample indices ∝ weights (ref: rng.cuh discrete)."""
+    w = jnp.asarray(weights)
+    return jax.random.choice(
+        state.next_key(), w.shape[0], _shape(shape), replace=True, p=w / w.sum()
+    ).astype(dtype)
+
+
+def rng_fill(state: RngState, shape: Shape, val, dtype=jnp.float32):
+    """Constant fill through the RNG API (ref: rng.cuh rng_fill)."""
+    del state
+    return jnp.full(_shape(shape), val, dtype=dtype)
+
+
+def sample_without_replacement(
+    state: RngState,
+    n: int,
+    n_samples: int,
+    weights=None,
+    inputs=None,
+):
+    """Weighted sampling without replacement via the Gumbel-top-k trick.
+
+    Ref: rng.cuh sample_without_replacement — the reference perturbs log
+    weights with Gumbel noise then sorts (detail/rng_impl.cuh); identical
+    algorithm here, expressed as top_k on the MXU-friendly dense array.
+    Returns (samples_or_none, indices).
+    """
+    expects(n_samples <= n, "sampledLen must be <= len")
+    if weights is None:
+        logw = jnp.zeros((n,), jnp.float32)
+    else:
+        logw = jnp.log(jnp.asarray(weights, jnp.float32))
+    g = jax.random.gumbel(state.next_key(), (n,), dtype=jnp.float32)
+    _, idx = jax.lax.top_k(logw + g, n_samples)
+    idx = idx.astype(jnp.int32)
+    out = None if inputs is None else jnp.take(jnp.asarray(inputs), idx, axis=0)
+    return out, idx
+
+
+def permute(state: RngState, n: int, inputs=None, rows: bool = True):
+    """Random permutation; optionally permute array rows
+    (ref: random/permute.cuh)."""
+    perm = jax.random.permutation(state.next_key(), n).astype(jnp.int32)
+    if inputs is None:
+        return perm
+    x = jnp.asarray(inputs)
+    return (jnp.take(x, perm, axis=0) if rows else jnp.take(x, perm, axis=1)), perm
+
+
+def multi_variable_gaussian(
+    state: RngState,
+    mean,
+    cov,
+    n_samples: int,
+    method: str = "cholesky",
+):
+    """Samples from N(mean, cov) (ref: random/multi_variable_gaussian.cuh;
+    method ∈ {cholesky, jacobi} mirrors the reference's decomposition
+    choice). Returns (n_samples, dim)."""
+    mean = jnp.asarray(mean, jnp.float32)
+    cov = jnp.asarray(cov, jnp.float32)
+    dim = mean.shape[0]
+    z = jax.random.normal(state.next_key(), (n_samples, dim), dtype=jnp.float32)
+    if method == "cholesky":
+        l = jnp.linalg.cholesky(cov + 1e-6 * jnp.eye(dim, dtype=cov.dtype))
+        return mean[None, :] + jnp.matmul(z, l.T, precision="highest")
+    w, v = jnp.linalg.eigh(cov)
+    factor = v * jnp.sqrt(jnp.clip(w, 0))[None, :]
+    return mean[None, :] + jnp.matmul(z, factor.T, precision="highest")

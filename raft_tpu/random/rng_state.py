@@ -1,0 +1,52 @@
+"""RNG state: seed + subsequence with a generator-type tag.
+
+Ref: ``raft::random::RngState`` (cpp/include/raft/random/rng_state.hpp:28-52)
+carrying {seed, base_subsequence, GeneratorType {GenPhilox, GenPC}}.
+
+TPU-native: JAX's counter-based threefry is the natural analog of the
+reference's counter-based Philox/PCG; ``seed`` maps to ``jax.random.key``
+and ``base_subsequence`` / ``advance`` map to ``fold_in`` — identical
+reproducible-stream semantics without device-side state.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import jax
+
+
+class GeneratorType(enum.Enum):
+    """Ref: random/rng_state.hpp:28 — kept for API parity; both map to
+    threefry on TPU."""
+
+    GenPhilox = 0
+    GenPC = 1
+
+
+@dataclass
+class RngState:
+    """Reproducible RNG stream state (ref: rng_state.hpp:37-52)."""
+
+    seed: int = 0
+    base_subsequence: int = 0
+    type: GeneratorType = GeneratorType.GenPC
+
+    def key(self) -> jax.Array:
+        """Derive the jax PRNG key for the current (seed, subsequence)."""
+        k = jax.random.key(self.seed)
+        if self.base_subsequence:
+            k = jax.random.fold_in(k, self.base_subsequence)
+        return k
+
+    def advance(self, subsequences: int = 1) -> None:
+        """Advance the stream (ref: RngState::advance) — subsequent draws
+        are independent of earlier ones."""
+        self.base_subsequence += subsequences
+
+    def next_key(self) -> jax.Array:
+        """Key for the current subsequence, then advance."""
+        k = self.key()
+        self.advance()
+        return k

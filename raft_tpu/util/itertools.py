@@ -1,0 +1,12 @@
+"""Parameter-sweep helpers (ref: cpp/include/raft/util/itertools.hpp)."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable, List, Tuple
+
+
+def product_of_lists(*lists: Iterable[Any]) -> List[Tuple[Any, ...]]:
+    """Cartesian product of parameter lists, used to build test/bench
+    configuration sweeps (ref: util/itertools.hpp product<>)."""
+    return list(itertools.product(*lists))

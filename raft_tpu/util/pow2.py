@@ -1,0 +1,56 @@
+"""Power-of-two alignment / rounding helpers.
+
+Ref: ``raft::Pow2`` (cpp/include/raft/util/pow2_utils.cuh) and ``ceildiv``
+(cpp/include/raft/util/cuda_utils.cuh). Used to size Pallas block grids and
+padded list capacities.
+"""
+
+from __future__ import annotations
+
+
+def ceildiv(a: int, b: int) -> int:
+    """Ceiling division (ref: raft::ceildiv)."""
+    return -(-a // b)
+
+
+def is_pow2(v: int) -> bool:
+    return v > 0 and (v & (v - 1)) == 0
+
+
+def round_up_safe(v: int, multiple: int) -> int:
+    """Round up to a multiple (ref: raft::round_up_safe)."""
+    return ceildiv(v, multiple) * multiple
+
+
+def round_down_safe(v: int, multiple: int) -> int:
+    """Round down to a multiple (ref: raft::round_down_safe)."""
+    return (v // multiple) * multiple
+
+
+class Pow2:
+    """Alignment helpers for a power-of-two value (ref: util/pow2_utils.cuh).
+
+    ``Pow2(128).round_up(x)`` etc. — mask-based, mirroring the reference's
+    template with a runtime value.
+    """
+
+    def __init__(self, value: int):
+        if not is_pow2(value):
+            raise ValueError(f"Pow2 requires a power of two, got {value}")
+        self.value = value
+        self.mask = value - 1
+
+    def round_up(self, x: int) -> int:
+        return (x + self.mask) & ~self.mask
+
+    def round_down(self, x: int) -> int:
+        return x & ~self.mask
+
+    def div(self, x: int) -> int:
+        return x >> self.value.bit_length() - 1
+
+    def mod(self, x: int) -> int:
+        return x & self.mask
+
+    def is_aligned(self, x: int) -> bool:
+        return (x & self.mask) == 0

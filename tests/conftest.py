@@ -1,0 +1,32 @@
+"""Test configuration: force the CPU backend with 8 virtual devices so the
+multi-device (mesh/collective) paths are exercised without TPU hardware —
+the role raft-dask's LocalCUDACluster fixture plays in the reference
+(ref: python/raft-dask/raft_dask/test/conftest.py:19-51)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def handle():
+    from raft_tpu.core.resources import DeviceResources
+
+    return DeviceResources(seed=0)

@@ -1,0 +1,144 @@
+"""Core layer tests (ref test model: cpp/test/core/*)."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import (
+    DeviceResources,
+    KeyValuePair,
+    LogicError,
+    Resources,
+    deserialize_mdspan,
+    deserialize_scalar,
+    expects,
+    operators as ops,
+    serialize_mdspan,
+    serialize_scalar,
+)
+from raft_tpu.core.interruptible import Interruptible, InterruptedException, synchronize
+from raft_tpu.core.mdarray import check_matrix, check_vector
+from raft_tpu.util import Pow2, ceildiv, round_up_safe
+
+
+class TestResources:
+    def test_lazy_slots(self):
+        res = Resources()
+        assert res.device is not None
+        assert res.mesh is not None
+
+    def test_shallow_copy_shares_objects_not_table(self):
+        res = Resources()
+        obj = object()
+        res.set_resource("x", obj)
+        copy = Resources(res)
+        assert copy.get_resource("x") is obj  # resource objects shared
+        # ...but the slot table is independent: rebinding on the copy (or
+        # constructor overrides) never mutates the source handle.
+        copy.set_resource("x", "other")
+        assert res.get_resource("x") is obj
+        override = Resources(res, x="tpu1")
+        assert override.get_resource("x") == "tpu1"
+        assert res.get_resource("x") is obj
+
+    def test_key_stream_advances(self):
+        h = DeviceResources(seed=0)
+        k1, k2 = h.next_key(), h.next_key()
+        assert not np.array_equal(
+            jax.random.key_data(k1), jax.random.key_data(k2)
+        )
+
+    def test_comms_missing_raises(self):
+        res = Resources()
+        with pytest.raises(LogicError):
+            res.get_comms()
+
+    def test_subcomm_roundtrip(self):
+        res = Resources()
+        res.set_subcomm("row", "fake-comm")
+        assert res.get_subcomm("row") == "fake-comm"
+
+
+class TestValidation:
+    def test_check_matrix(self):
+        x = np.zeros((3, 4), np.float32)
+        arr = check_matrix(x, rows=3, cols=4, dtype=jnp.float32)
+        assert arr.shape == (3, 4)
+
+    def test_check_matrix_bad_shape(self):
+        with pytest.raises(LogicError):
+            check_matrix(np.zeros((3, 4), np.float32), rows=5)
+
+    def test_check_vector_bad_rank(self):
+        with pytest.raises(LogicError):
+            check_vector(np.zeros((3, 4), np.float32))
+
+    def test_expects(self):
+        expects(True)
+        with pytest.raises(LogicError):
+            expects(False, "nope")
+
+
+class TestSerialize:
+    def test_mdspan_roundtrip(self):
+        buf = io.BytesIO()
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        serialize_mdspan(buf, a)
+        serialize_mdspan(buf, jnp.ones((2, 2), jnp.int32))
+        buf.seek(0)
+        b = deserialize_mdspan(buf)
+        c = deserialize_mdspan(buf)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(np.ones((2, 2), np.int32), c)
+
+    def test_scalar_roundtrip(self):
+        buf = io.BytesIO()
+        serialize_scalar(buf, 7, np.int64)
+        serialize_scalar(buf, 2.5, np.float32)
+        buf.seek(0)
+        assert deserialize_scalar(buf, np.int64) == 7
+        assert deserialize_scalar(buf, np.float32) == np.float32(2.5)
+
+
+class TestOperators:
+    def test_argmin_op(self):
+        a = KeyValuePair(jnp.int32(3), jnp.float32(1.0))
+        b = KeyValuePair(jnp.int32(1), jnp.float32(1.0))
+        out = ops.argmin_op(a, b)
+        assert int(out.key) == 1  # tie → smaller key
+
+    def test_compose(self):
+        f = ops.compose_op(ops.sqrt_op, ops.sq_op)
+        assert float(f(jnp.float32(3.0))) == pytest.approx(3.0)
+
+
+class TestInterruptible:
+    def test_sync_ok(self):
+        x = jnp.ones((4,))
+        synchronize(x)
+
+    def test_cancel_raises(self):
+        tok = Interruptible.get_token()
+        tok.cancel()
+        with pytest.raises(InterruptedException):
+            tok.interruptible_check()
+        tok.interruptible_check()  # flag cleared
+
+
+class TestUtil:
+    def test_ceildiv(self):
+        assert ceildiv(10, 3) == 4
+
+    def test_pow2(self):
+        p = Pow2(128)
+        assert p.round_up(130) == 256
+        assert p.round_down(130) == 128
+        assert p.is_aligned(256)
+        assert round_up_safe(5, 4) == 8
+
+    def test_pow2_rejects_non_pow2(self):
+        with pytest.raises(ValueError):
+            Pow2(100)

@@ -1,0 +1,190 @@
+"""Linalg tests — compare against numpy host references, the reference's
+test style (ref: cpp/test/linalg/*)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import linalg
+from raft_tpu.core import operators as ops
+
+
+@pytest.fixture
+def mats(rng):
+    a = rng.standard_normal((16, 8)).astype(np.float32)
+    b = rng.standard_normal((16, 8)).astype(np.float32)
+    return a, b
+
+
+class TestElementwise:
+    def test_basic_ops(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(linalg.add(a, b), a + b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.subtract(a, b), a - b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.multiply(a, b), a * b, rtol=1e-6)
+        np.testing.assert_allclose(linalg.divide(a, b + 10), a / (b + 10), rtol=1e-5)
+        np.testing.assert_allclose(linalg.sqrt(np.abs(a)), np.sqrt(np.abs(a)), rtol=1e-6)
+
+    def test_map_offset(self):
+        out = linalg.map_offset((2, 3), lambda i: i * 2)
+        np.testing.assert_array_equal(out, np.arange(6).reshape(2, 3) * 2)
+
+    def test_unary_binary_ternary(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(linalg.unary_op(a, ops.sq_op), a * a, rtol=1e-6)
+        np.testing.assert_allclose(
+            linalg.ternary_op(a, b, a, lambda x, y, z: x + y + z), a + b + a, rtol=1e-5
+        )
+
+
+class TestReduce:
+    def test_row_reduce(self, mats):
+        a, _ = mats
+        np.testing.assert_allclose(linalg.reduce(a, axis=1), a.sum(1), rtol=1e-5)
+
+    def test_sq_reduce_with_finop(self, mats):
+        a, _ = mats
+        out = linalg.reduce(a, axis=1, main_op=ops.sq_op, final_op=ops.sqrt_op)
+        np.testing.assert_allclose(out, np.sqrt((a * a).sum(1)), rtol=1e-5)
+
+    def test_map_reduce(self, mats):
+        a, b = mats
+        out = linalg.map_reduce(ops.sqdiff_op, ops.add_op, a, b)
+        np.testing.assert_allclose(out, ((a - b) ** 2).sum(), rtol=1e-4)
+
+    def test_reduce_rows_by_key(self, rng):
+        x = rng.standard_normal((20, 4)).astype(np.float32)
+        keys = rng.integers(0, 5, 20)
+        out = linalg.reduce_rows_by_key(x, keys, 5)
+        expected = np.zeros((5, 4), np.float32)
+        for i, k in enumerate(keys):
+            expected[k] += x[i]
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_reduce_cols_by_key(self, rng):
+        x = rng.standard_normal((4, 20)).astype(np.float32)
+        keys = rng.integers(0, 5, 20)
+        out = linalg.reduce_cols_by_key(x, keys, 5)
+        expected = np.zeros((4, 5), np.float32)
+        for j, k in enumerate(keys):
+            expected[:, k] += x[:, j]
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_mse(self, mats):
+        a, b = mats
+        np.testing.assert_allclose(
+            linalg.mean_squared_error(a, b), ((a - b) ** 2).mean(), rtol=1e-5
+        )
+
+
+class TestNorm:
+    def test_row_norms(self, mats):
+        a, _ = mats
+        np.testing.assert_allclose(
+            linalg.row_norm(a, linalg.L1Norm), np.abs(a).sum(1), rtol=1e-5
+        )
+        # L2Norm is squared unless fin_op sqrt — reference semantics.
+        np.testing.assert_allclose(
+            linalg.row_norm(a, linalg.L2Norm), (a * a).sum(1), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            linalg.row_norm(a, linalg.L2Norm, fin_op=ops.sqrt_op),
+            np.linalg.norm(a, axis=1),
+            rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            linalg.row_norm(a, linalg.LinfNorm), np.abs(a).max(1), rtol=1e-6
+        )
+
+    def test_normalize(self, mats):
+        a, _ = mats
+        out = np.asarray(linalg.normalize(a))
+        np.testing.assert_allclose(np.linalg.norm(out, axis=1), 1.0, rtol=1e-5)
+
+
+class TestBlas:
+    def test_gemm(self, rng):
+        a = rng.standard_normal((8, 5)).astype(np.float32)
+        b = rng.standard_normal((5, 7)).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemm(a, b), a @ b, rtol=1e-4, atol=1e-5)
+
+    def test_gemm_trans_alpha_beta(self, rng):
+        a = rng.standard_normal((5, 8)).astype(np.float32)
+        b = rng.standard_normal((5, 7)).astype(np.float32)
+        c = rng.standard_normal((8, 7)).astype(np.float32)
+        out = linalg.gemm(a, b, alpha=2.0, beta=0.5, c=c, trans_a=True)
+        np.testing.assert_allclose(out, 2 * (a.T @ b) + 0.5 * c, rtol=1e-4, atol=1e-4)
+
+    def test_gemv_axpy_dot(self, rng):
+        a = rng.standard_normal((6, 4)).astype(np.float32)
+        x = rng.standard_normal(4).astype(np.float32)
+        y = rng.standard_normal(6).astype(np.float32)
+        np.testing.assert_allclose(linalg.gemv(a, x), a @ x, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(linalg.axpy(2.0, y, y), 3 * y, rtol=1e-5)
+        np.testing.assert_allclose(
+            linalg.dot(x, x), float((x * x).sum()), rtol=1e-4
+        )
+
+    def test_matrix_vector_op(self, rng):
+        m = rng.standard_normal((6, 4)).astype(np.float32)
+        v = rng.standard_normal(4).astype(np.float32)
+        out = linalg.matrix_vector_op(m, v, ops.add_op, along_rows=True)
+        np.testing.assert_allclose(out, m + v[None, :], rtol=1e-5)
+
+
+class TestDecomp:
+    def test_qr(self, rng):
+        x = rng.standard_normal((10, 4)).astype(np.float32)
+        q, r = linalg.qr_get_qr(x)
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), x, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(q).T @ np.asarray(q), np.eye(4), atol=1e-4
+        )
+
+    def test_eig(self, rng):
+        x = rng.standard_normal((6, 6)).astype(np.float32)
+        s = (x + x.T) / 2
+        w, v = linalg.eig_dc(s)
+        np.testing.assert_allclose(
+            np.asarray(v) @ np.diag(np.asarray(w)) @ np.asarray(v).T, s, atol=1e-3
+        )
+
+    def test_svd(self, rng):
+        x = rng.standard_normal((10, 4)).astype(np.float32)
+        u, s, v = linalg.svd_qr(x)
+        np.testing.assert_allclose(
+            np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T, x, atol=1e-3
+        )
+
+    def test_svd_eig(self, rng):
+        x = rng.standard_normal((12, 4)).astype(np.float32)
+        u, s, v = linalg.svd_eig(x)
+        np.testing.assert_allclose(
+            np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T, x, atol=2e-3
+        )
+
+    def test_rsvd_recovers_low_rank(self, rng):
+        # Exact-rank matrix: rsvd should recover it to high accuracy.
+        u0 = rng.standard_normal((50, 3)).astype(np.float32)
+        v0 = rng.standard_normal((3, 20)).astype(np.float32)
+        x = u0 @ v0
+        u, s, v = linalg.rsvd(x, k=3, n_iters=3)
+        recon = np.asarray(u) @ np.diag(np.asarray(s)) @ np.asarray(v).T
+        np.testing.assert_allclose(recon, x, atol=1e-2)
+
+    def test_lstsq(self, rng):
+        a = rng.standard_normal((20, 5)).astype(np.float32)
+        w_true = rng.standard_normal(5).astype(np.float32)
+        b = a @ w_true
+        np.testing.assert_allclose(linalg.lstsq_svd(a, b), w_true, atol=1e-3)
+        np.testing.assert_allclose(linalg.lstsq_eig(a, b), w_true, atol=1e-2)
+
+    def test_cholesky_rank_one_update(self, rng):
+        a = rng.standard_normal((5, 5)).astype(np.float32)
+        spd = a @ a.T + 5 * np.eye(5, dtype=np.float32)
+        v = rng.standard_normal(5).astype(np.float32)
+        l = np.linalg.cholesky(spd)
+        l_up = linalg.cholesky_rank_one_update(l, v)
+        np.testing.assert_allclose(
+            np.asarray(l_up) @ np.asarray(l_up).T, spd + np.outer(v, v), atol=1e-3
+        )

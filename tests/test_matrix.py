@@ -1,0 +1,109 @@
+"""Matrix ops + select_k tests (ref: cpp/test/matrix/*, esp. the select_k
+input generators in cpp/internal/raft_internal/matrix/select_k.cuh)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import matrix
+from raft_tpu.matrix import SelectMethod, select_k
+
+
+class TestMatrixOps:
+    def test_argmax_argmin(self, rng):
+        x = rng.standard_normal((5, 9)).astype(np.float32)
+        np.testing.assert_array_equal(matrix.argmax(x), x.argmax(1))
+        np.testing.assert_array_equal(matrix.argmin(x), x.argmin(1))
+
+    def test_gather(self, rng):
+        x = rng.standard_normal((8, 3)).astype(np.float32)
+        idx = np.array([3, 1, 7])
+        np.testing.assert_array_equal(matrix.gather(x, idx), x[idx])
+
+    def test_gather_if(self, rng):
+        x = rng.standard_normal((8, 3)).astype(np.float32)
+        idx = np.array([0, 1, 2, 3])
+        stencil = np.array([1.0, -1.0, 1.0, -1.0], np.float32)
+        out = np.asarray(matrix.gather_if(x, idx, stencil, lambda s: s > 0))
+        np.testing.assert_array_equal(out[0], x[0])
+        np.testing.assert_array_equal(out[1], np.zeros(3))
+
+    def test_slice_copy_init_reverse(self, rng):
+        x = rng.standard_normal((6, 6)).astype(np.float32)
+        np.testing.assert_array_equal(matrix.slice_(x, 1, 2, 4, 5), x[1:4, 2:5])
+        np.testing.assert_array_equal(matrix.copy(x), x)
+        np.testing.assert_array_equal(
+            matrix.init((2, 2), 3.0), np.full((2, 2), 3.0, np.float32)
+        )
+        np.testing.assert_array_equal(matrix.reverse(x, True), x[:, ::-1])
+        np.testing.assert_array_equal(matrix.reverse(x, False), x[::-1])
+
+    def test_sign_flip(self, rng):
+        x = rng.standard_normal((6, 3)).astype(np.float32)
+        out = np.asarray(matrix.sign_flip(x))
+        for j in range(3):
+            assert out[np.abs(out[:, j]).argmax(), j] >= 0
+
+    def test_col_wise_sort(self, rng):
+        x = rng.standard_normal((6, 3)).astype(np.float32)
+        out = np.asarray(matrix.col_wise_sort(x))
+        np.testing.assert_array_equal(out, np.sort(x, axis=0))
+
+    def test_triangular(self, rng):
+        x = rng.standard_normal((4, 4)).astype(np.float32)
+        np.testing.assert_array_equal(matrix.triangular_upper(x), np.triu(x))
+
+
+def _check_select(values, k, select_min, method=SelectMethod.kAuto):
+    out_v, out_i = select_k(values, k, select_min=select_min, method=method)
+    out_v, out_i = np.asarray(out_v), np.asarray(out_i)
+    ref = np.sort(values, axis=-1)
+    ref = ref[:, :k] if select_min else ref[:, ::-1][:, :k]
+    np.testing.assert_allclose(out_v, ref, rtol=1e-6)
+    # indices actually point at the selected values
+    np.testing.assert_allclose(
+        np.take_along_axis(values, out_i, axis=-1), out_v, rtol=1e-6
+    )
+
+
+class TestSelectK:
+    @pytest.mark.parametrize("select_min", [True, False])
+    @pytest.mark.parametrize("k", [1, 5, 17])
+    def test_small(self, rng, k, select_min):
+        x = rng.standard_normal((7, 100)).astype(np.float32)
+        _check_select(x, k, select_min)
+
+    @pytest.mark.parametrize("method", [SelectMethod.kTopK, SelectMethod.kTwoPhase])
+    def test_methods_agree(self, rng, method):
+        x = rng.standard_normal((4, 3000)).astype(np.float32)
+        _check_select(x, 32, True, method)
+
+    def test_two_phase_large(self, rng):
+        x = rng.standard_normal((2, 70000)).astype(np.float32)
+        _check_select(x, 64, True)
+
+    def test_k_ge_len(self, rng):
+        x = rng.standard_normal((3, 10)).astype(np.float32)
+        v, i = select_k(x, 10, select_min=True)
+        np.testing.assert_allclose(np.asarray(v), np.sort(x, 1), rtol=1e-6)
+
+    def test_payload_indices(self, rng):
+        x = rng.standard_normal((2, 50)).astype(np.float32)
+        payload = (np.arange(50)[None, :] + 1000 * np.arange(2)[:, None]).astype(
+            np.int32
+        )
+        v, i = select_k(x, 5, select_min=True, indices=payload)
+        expect = x.argsort(1)[:, :5] + 1000 * np.arange(2)[:, None]
+        np.testing.assert_array_equal(np.asarray(i), expect)
+
+    def test_vector_input(self, rng):
+        x = rng.standard_normal(100).astype(np.float32)
+        v, i = select_k(x, 3)
+        np.testing.assert_allclose(np.asarray(v), np.sort(x)[:3], rtol=1e-6)
+
+    def test_int_values(self, rng):
+        x = rng.integers(-1000, 1000, (4, 200)).astype(np.int32)
+        v, i = select_k(x, 7, select_min=True)
+        np.testing.assert_array_equal(np.asarray(v), np.sort(x, 1)[:, :7])
+        v, i = select_k(x, 7, select_min=False)
+        np.testing.assert_array_equal(np.asarray(v), np.sort(x, 1)[:, ::-1][:, :7])

@@ -1,0 +1,147 @@
+"""Random module tests (ref: cpp/test/random/*) — distribution moments
+checked statistically, like the reference's mean/std assertions."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu import random as rr
+from raft_tpu.random import RngState
+
+
+N = 20000
+
+
+class TestDistributions:
+    def test_uniform(self):
+        x = np.asarray(rr.uniform(RngState(1), N, 2.0, 5.0))
+        assert x.min() >= 2.0 and x.max() < 5.0
+        assert abs(x.mean() - 3.5) < 0.05
+
+    def test_uniform_int(self):
+        x = np.asarray(rr.uniformInt(RngState(1), N, 0, 10))
+        assert set(np.unique(x)) <= set(range(10))
+
+    def test_normal(self):
+        x = np.asarray(rr.normal(RngState(2), N, 3.0, 2.0))
+        assert abs(x.mean() - 3.0) < 0.1
+        assert abs(x.std() - 2.0) < 0.1
+
+    def test_lognormal(self):
+        x = np.asarray(rr.lognormal(RngState(3), N, 0.0, 0.5))
+        assert abs(np.log(x).mean()) < 0.05
+
+    def test_laplace_gumbel_logistic(self):
+        for fn in (rr.laplace, rr.gumbel, rr.logistic):
+            x = np.asarray(fn(RngState(4), N, 0.0, 1.0))
+            assert np.isfinite(x).all()
+
+    def test_exponential(self):
+        x = np.asarray(rr.exponential(RngState(5), N, 2.0))
+        assert abs(x.mean() - 0.5) < 0.05
+
+    def test_rayleigh(self):
+        x = np.asarray(rr.rayleigh(RngState(6), N, 1.0))
+        assert abs(x.mean() - np.sqrt(np.pi / 2)) < 0.05
+
+    def test_bernoulli(self):
+        x = np.asarray(rr.bernoulli(RngState(7), N, 0.3))
+        assert abs(x.mean() - 0.3) < 0.03
+
+    def test_scaled_bernoulli(self):
+        x = np.asarray(rr.scaled_bernoulli(RngState(8), N, 0.5, 2.0))
+        assert set(np.unique(x)) == {-2.0, 2.0}
+
+    def test_discrete(self):
+        w = np.array([0.1, 0.9])
+        x = np.asarray(rr.discrete(RngState(9), N, w))
+        assert abs(x.mean() - 0.9) < 0.03
+
+    def test_reproducible_streams(self):
+        a = np.asarray(rr.uniform(RngState(42), 100))
+        b = np.asarray(rr.uniform(RngState(42), 100))
+        np.testing.assert_array_equal(a, b)
+        c = np.asarray(rr.uniform(RngState(42, base_subsequence=1), 100))
+        assert not np.array_equal(a, c)
+
+
+class TestSampling:
+    def test_sample_without_replacement_unique(self):
+        _, idx = rr.sample_without_replacement(RngState(1), 100, 50)
+        idx = np.asarray(idx)
+        assert len(np.unique(idx)) == 50
+        assert idx.min() >= 0 and idx.max() < 100
+
+    def test_sample_weighted_bias(self):
+        w = np.ones(100)
+        w[:10] = 1000.0
+        hits = 0
+        for s in range(20):
+            _, idx = rr.sample_without_replacement(RngState(s), 100, 10, weights=w)
+            hits += np.isin(np.asarray(idx), np.arange(10)).sum()
+        assert hits > 150  # heavy weights dominate
+
+    def test_permute(self):
+        perm = np.asarray(rr.permute(RngState(1), 50))
+        np.testing.assert_array_equal(np.sort(perm), np.arange(50))
+
+    def test_permute_rows(self):
+        x = np.arange(20, dtype=np.float32).reshape(10, 2)
+        out, perm = rr.permute(RngState(2), 10, x)
+        np.testing.assert_allclose(np.asarray(out), x[np.asarray(perm)])
+
+    def test_mvg(self):
+        mean = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        x = np.asarray(rr.multi_variable_gaussian(RngState(3), mean, cov, 50000))
+        np.testing.assert_allclose(x.mean(0), mean, atol=0.05)
+        np.testing.assert_allclose(np.cov(x.T), cov, atol=0.1)
+
+
+class TestMakeBlobs:
+    def test_shapes_and_labels(self):
+        x, y = rr.make_blobs(500, 8, n_clusters=4, seed=3)
+        assert x.shape == (500, 8)
+        assert set(np.unique(np.asarray(y))) == {0, 1, 2, 3}
+
+    def test_clusters_are_tight(self):
+        x, y = rr.make_blobs(600, 4, n_clusters=3, cluster_std=0.01, seed=1)
+        x, y = np.asarray(x), np.asarray(y)
+        for c in range(3):
+            assert x[y == c].std(0).max() < 0.05
+
+    def test_given_centers(self):
+        centers = np.array([[0.0, 0.0], [100.0, 100.0]], np.float32)
+        x, y = rr.make_blobs(100, 2, centers=centers, cluster_std=0.1, seed=0)
+        x, y = np.asarray(x), np.asarray(y)
+        np.testing.assert_allclose(x[y == 1].mean(0), [100, 100], atol=1.0)
+
+
+class TestMakeRegression:
+    def test_exact_recovery_no_noise(self):
+        x, y, coef = rr.make_regression(50, 6, noise=0.0, shuffle=False, seed=0)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x) @ np.asarray(coef), atol=1e-3
+        )
+
+
+class TestRmat:
+    def test_edges_in_range(self):
+        theta = np.array([0.57, 0.19, 0.19, 0.05], np.float32)
+        src, dst = rr.rmat_rectangular_gen(RngState(1), theta, 8, 8, 5000)
+        src, dst = np.asarray(src), np.asarray(dst)
+        assert src.min() >= 0 and src.max() < 256
+        assert dst.min() >= 0 and dst.max() < 256
+
+    def test_skew(self):
+        # a-heavy theta concentrates edges in low ids.
+        theta = np.array([0.9, 0.05, 0.04, 0.01], np.float32)
+        src, _ = rr.rmat_rectangular_gen(RngState(2), theta, 10, 10, 5000)
+        assert np.median(np.asarray(src)) < 128
+
+    def test_rectangular(self):
+        theta = np.array([0.25, 0.25, 0.25, 0.25], np.float32)
+        src, dst = rr.rmat_rectangular_gen(RngState(3), theta, 4, 8, 2000)
+        assert np.asarray(src).max() < 16
+        assert np.asarray(dst).max() < 256
+        assert np.asarray(dst).max() >= 16  # actually uses the col range
