@@ -1,0 +1,34 @@
+"""Pairwise distances, fused/masked nearest-neighbor reductions and gram
+kernels (ref: cpp/include/raft/distance, ~7,900 LoC CUDA)."""
+
+from raft_tpu.distance.distance_types import (
+    DistanceType,
+    KernelType,
+    DISTANCE_TYPES,
+    SUPPORTED_DISTANCES,
+    is_min_close,
+    resolve_metric,
+)
+from raft_tpu.distance.pairwise import distance, pairwise_distance
+from raft_tpu.distance.fused_l2_nn import (
+    fused_l2_nn_min_reduce,
+    fused_l2_nn_argmin,
+)
+from raft_tpu.distance.masked_nn import masked_l2_nn
+from raft_tpu.distance.kernels import (
+    KernelParams,
+    GramMatrixBase,
+    PolynomialKernel,
+    TanhKernel,
+    RBFKernel,
+    kernel_factory,
+)
+
+__all__ = [
+    "DistanceType", "KernelType", "DISTANCE_TYPES", "SUPPORTED_DISTANCES",
+    "is_min_close", "resolve_metric",
+    "distance", "pairwise_distance",
+    "fused_l2_nn_min_reduce", "fused_l2_nn_argmin", "masked_l2_nn",
+    "KernelParams", "GramMatrixBase", "PolynomialKernel", "TanhKernel",
+    "RBFKernel", "kernel_factory",
+]

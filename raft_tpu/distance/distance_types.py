@@ -1,0 +1,111 @@
+"""Distance metric vocabulary.
+
+Ref: cpp/include/raft/distance/distance_types.hpp:23-67 (``DistanceType``
+enum of 20 metrics + Precomputed) and the metric-name dictionary pylibraft
+exposes (python/pylibraft/pylibraft/distance/pairwise_distance.pyx:62-83).
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class DistanceType(enum.IntEnum):
+    """Ref: distance/distance_types.hpp:23-67, same numeric values."""
+
+    L2Expanded = 0
+    L2SqrtExpanded = 1
+    CosineExpanded = 2
+    L1 = 3
+    L2Unexpanded = 4
+    L2SqrtUnexpanded = 5
+    InnerProduct = 6
+    Linf = 7
+    Canberra = 8
+    LpUnexpanded = 9
+    CorrelationExpanded = 10
+    JaccardExpanded = 11
+    HellingerExpanded = 12
+    Haversine = 13
+    BrayCurtis = 14
+    JensenShannon = 15
+    HammingUnexpanded = 16
+    KLDivergence = 17
+    RusselRaoExpanded = 18
+    DiceExpanded = 19
+    Precomputed = 100
+
+
+def is_min_close(metric: DistanceType) -> bool:
+    """Whether smaller values mean closer neighbors.
+
+    Ref: distance/distance_types.hpp:72-87 — similarity metrics
+    (InnerProduct, Cosine, Correlation) select max.
+    """
+    return metric not in (
+        DistanceType.InnerProduct,
+        DistanceType.CosineExpanded,
+        DistanceType.CorrelationExpanded,
+    )
+
+
+# Metric-name → DistanceType map, identical to pylibraft's DISTANCE_TYPES
+# (ref: distance/pairwise_distance.pyx:62-83).
+DISTANCE_TYPES = {
+    "l2": DistanceType.L2SqrtUnexpanded,
+    "sqeuclidean": DistanceType.L2Unexpanded,
+    "euclidean": DistanceType.L2SqrtUnexpanded,
+    "l1": DistanceType.L1,
+    "cityblock": DistanceType.L1,
+    "inner_product": DistanceType.InnerProduct,
+    "chebyshev": DistanceType.Linf,
+    "linf": DistanceType.Linf,
+    "canberra": DistanceType.Canberra,
+    "cosine": DistanceType.CosineExpanded,
+    "lp": DistanceType.LpUnexpanded,
+    "correlation": DistanceType.CorrelationExpanded,
+    "jaccard": DistanceType.JaccardExpanded,
+    "hellinger": DistanceType.HellingerExpanded,
+    "haversine": DistanceType.Haversine,
+    "braycurtis": DistanceType.BrayCurtis,
+    "jensenshannon": DistanceType.JensenShannon,
+    "hamming": DistanceType.HammingUnexpanded,
+    "kl_divergence": DistanceType.KLDivergence,
+    "minkowski": DistanceType.LpUnexpanded,
+    "russellrao": DistanceType.RusselRaoExpanded,
+    "dice": DistanceType.DiceExpanded,
+    # Expanded L2 aliases (scipy has no analog; used by internal callers).
+    "sqeuclidean_expanded": DistanceType.L2Expanded,
+    "euclidean_expanded": DistanceType.L2SqrtExpanded,
+}
+
+SUPPORTED_DISTANCES = [
+    "euclidean", "l1", "cityblock", "l2", "inner_product", "chebyshev",
+    "minkowski", "canberra", "kl_divergence", "correlation", "russellrao",
+    "hellinger", "lp", "hamming", "jensenshannon", "cosine", "sqeuclidean",
+]
+
+
+def resolve_metric(metric) -> DistanceType:
+    """Accept either a DistanceType or a pylibraft-style metric name."""
+    if isinstance(metric, DistanceType):
+        return metric
+    if isinstance(metric, str):
+        try:
+            return DISTANCE_TYPES[metric.lower()]
+        except KeyError:
+            raise ValueError(
+                f"metric '{metric}' is not supported; one of "
+                f"{sorted(DISTANCE_TYPES)}"
+            ) from None
+    return DistanceType(metric)
+
+
+class KernelType(enum.IntEnum):
+    """Gram-matrix kernel functions (ref: distance_types.hpp:90
+    ``kernels::KernelType {LINEAR, POLYNOMIAL, RBF, TANH}``)."""
+
+    LINEAR = 0
+    POLYNOMIAL = 1
+    RBF = 2
+    TANH = 3

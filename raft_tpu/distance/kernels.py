@@ -1,0 +1,90 @@
+"""Gram / kernel matrices for SVM-style workloads.
+
+Ref: cpp/include/raft/distance/kernels.cuh with
+detail/kernels/{gram_matrix.cuh:39, kernel_matrices.cuh:107-269,
+kernel_factory.cuh}. Every kernel is ``f(x·yᵀ)`` or ``f(||x-y||²)`` — on TPU
+the gram matmul rides the MXU and XLA fuses the epilogue, so the reference's
+per-kernel CUDA epilogue kernels reduce to jnp expressions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.distance.distance_types import KernelType
+from raft_tpu.distance.pairwise import _l2_expanded
+from raft_tpu.linalg.blas import DEFAULT_PRECISION
+
+
+@dataclasses.dataclass
+class KernelParams:
+    """Ref: raft::distance::kernels::KernelParams (distance_types.hpp:92)."""
+
+    kernel: KernelType = KernelType.LINEAR
+    degree: int = 3
+    gamma: float = 1.0
+    coef0: float = 0.0
+
+
+class GramMatrixBase:
+    """Linear gram matrix x·yᵀ (ref: GramMatrixBase, gram_matrix.cuh:39)."""
+
+    def __call__(self, x, y) -> jax.Array:
+        x = as_array(x)
+        y = as_array(y)
+        return self.evaluate(x, y)
+
+    def evaluate(self, x, y) -> jax.Array:
+        return jnp.matmul(x, y.T, precision=DEFAULT_PRECISION)
+
+
+class PolynomialKernel(GramMatrixBase):
+    """(gain·x·yᵀ + offset)^exponent (ref: kernel_matrices.cuh:107)."""
+
+    def __init__(self, exponent: int = 3, gain: float = 1.0, offset: float = 0.0):
+        self.exponent = exponent
+        self.gain = gain
+        self.offset = offset
+
+    def evaluate(self, x, y) -> jax.Array:
+        return (self.gain * jnp.matmul(x, y.T, precision=DEFAULT_PRECISION) + self.offset) ** self.exponent
+
+
+class TanhKernel(GramMatrixBase):
+    """tanh(gain·x·yᵀ + offset) (ref: kernel_matrices.cuh:169)."""
+
+    def __init__(self, gain: float = 1.0, offset: float = 0.0):
+        self.gain = gain
+        self.offset = offset
+
+    def evaluate(self, x, y) -> jax.Array:
+        return jnp.tanh(self.gain * jnp.matmul(x, y.T, precision=DEFAULT_PRECISION) + self.offset)
+
+
+class RBFKernel(GramMatrixBase):
+    """exp(-gain·||x-y||²) (ref: kernel_matrices.cuh:219 — the reference
+    computes the expanded L2 with norm epilogue, same here)."""
+
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def evaluate(self, x, y) -> jax.Array:
+        d2 = _l2_expanded(as_array(x), as_array(y), sqrt=False)
+        return jnp.exp(-self.gain * d2)
+
+
+def kernel_factory(params: KernelParams) -> GramMatrixBase:
+    """Ref: KernelFactory::create (kernel_factory.cuh)."""
+    if params.kernel == KernelType.LINEAR:
+        return GramMatrixBase()
+    if params.kernel == KernelType.POLYNOMIAL:
+        return PolynomialKernel(params.degree, params.gamma, params.coef0)
+    if params.kernel == KernelType.TANH:
+        return TanhKernel(params.gamma, params.coef0)
+    if params.kernel == KernelType.RBF:
+        return RBFKernel(params.gamma)
+    raise ValueError(f"unknown kernel type {params.kernel!r}")

@@ -1,0 +1,65 @@
+"""Masked L2 nearest-neighbor: fused L2-NN over a group adjacency mask.
+
+Ref: cpp/include/raft/distance/masked_nn.cuh (``masked_l2_nn`` :148, detail
+masked_nn.cuh / masked_distance_base.cuh / compress_to_bits.cuh) — used by
+``connect_components`` in single-linkage clustering. The y rows are
+partitioned into groups; ``adj[i, g]`` says whether x-row i may match
+group g, and ``group_idxs[g]`` is the *end* offset of group g in y (the
+reference's uint64 bitfield compression of adj is a CUDA occupancy trick
+with no TPU analog — a boolean mask broadcast is fused into the epilogue).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.error import expects
+from raft_tpu.linalg.blas import DEFAULT_PRECISION
+
+
+def masked_l2_nn(
+    x,
+    y,
+    adj,
+    group_idxs,
+    sqrt: bool = False,
+    precision=DEFAULT_PRECISION,
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-x-row (min L2 distance, argmin) over mask-allowed y rows.
+
+    ``adj``: (m, num_groups) bool; ``group_idxs``: (num_groups,) int end
+    offsets partitioning y rows (ref: masked_nn.cuh:105-148 docs). Rows with
+    no allowed group return (+inf, -1), matching the reference's
+    ``initOutBuffer`` maxima.
+    """
+    x = as_array(x)
+    y = as_array(y)
+    adj = as_array(adj).astype(bool)
+    group_idxs = as_array(group_idxs).astype(jnp.int32)
+    expects(x.shape[1] == y.shape[1], "x and y must have the same n_cols")
+    m, k = x.shape
+    n = y.shape[0]
+    num_groups = group_idxs.shape[0]
+    expects(adj.shape == (m, num_groups), "adj must be (m, num_groups)")
+
+    # Map each y row to its group: group g spans [group_idxs[g-1], group_idxs[g]).
+    y_group = jnp.searchsorted(group_idxs, jnp.arange(n, dtype=jnp.int32), side="right")
+    allowed = jnp.take_along_axis(
+        adj, jnp.broadcast_to(y_group[None, :], (m, n)), axis=1
+    )  # (m, n)
+
+    xn = jnp.sum(x * x, axis=1)
+    yn = jnp.sum(y * y, axis=1)
+    d = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * jnp.matmul(x, y.T, precision=precision), 0.0)
+    if sqrt:
+        d = jnp.sqrt(d)
+    d = jnp.where(allowed, d, jnp.inf)
+    idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+    dmin = jnp.take_along_axis(d, idx[:, None], axis=1)[:, 0]
+    any_allowed = jnp.any(allowed, axis=1)
+    idx = jnp.where(any_allowed, idx, -1)
+    return dmin, idx

@@ -128,7 +128,9 @@ def lstsq_svd(a, b) -> jax.Array:
     a, b = as_array(a), as_array(b)
     u, s, vt = jnp.linalg.svd(a, full_matrices=False)
     s_inv = jnp.where(s > 1e-10 * s[0], 1.0 / s, 0.0)
-    return _mm(vt.T, s_inv * _mm(u.T, b))
+    utb = _mm(u.T, b)
+    scaled = s_inv[:, None] * utb if utb.ndim == 2 else s_inv * utb
+    return _mm(vt.T, scaled)
 
 
 def lstsq_eig(a, b) -> jax.Array:
@@ -139,7 +141,9 @@ def lstsq_eig(a, b) -> jax.Array:
     atb = _mm(a.T, b)
     w, v = jnp.linalg.eigh(ata)
     w_inv = jnp.where(w > 1e-10 * jnp.max(w), 1.0 / w, 0.0)
-    return _mm(v, w_inv * _mm(v.T, atb))
+    vtb = _mm(v.T, atb)
+    scaled = w_inv[:, None] * vtb if vtb.ndim == 2 else w_inv * vtb
+    return _mm(v, scaled)
 
 
 def cholesky_rank_one_update(l, v, lower: bool = True) -> jax.Array:

@@ -50,7 +50,7 @@ def reduce(
             red = jnp.maximum(red, jnp.asarray(init, mapped.dtype))
     else:
         init_arr = jnp.full((), 0 if init is None else init, dtype=mapped.dtype)
-        red = jax.lax.reduce(mapped, init_arr, reduce_op, (axis,))
+        red = jax.lax.reduce(mapped, init_arr, reduce_op, (axis % mapped.ndim,))
     return final_op(red)
 
 

@@ -41,7 +41,7 @@ def rmat_rectangular_gen(
     if theta.shape[0] == 1:
         theta = jnp.tile(theta, (depth, 1))
     expects(theta.shape[0] >= depth, "theta must provide max(r_scale,c_scale) levels")
-    theta = theta / theta.sum(axis=1, keepdims=True)
+    theta = theta[:depth] / theta[:depth].sum(axis=1, keepdims=True)
 
     u = jax.random.uniform(state.next_key(), (n_edges, depth))
     # Per level: quadrant = searchsorted(cumsum(theta_level), u).

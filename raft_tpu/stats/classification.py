@@ -1,0 +1,55 @@
+"""Classification metrics.
+
+Ref: cpp/include/raft/stats/{accuracy,contingency_matrix}.cuh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def accuracy(predictions, ref_predictions) -> jax.Array:
+    """Fraction of correctly predicted labels (ref: stats/accuracy.cuh)."""
+    p = as_array(predictions)
+    r = as_array(ref_predictions)
+    return jnp.mean((p == r).astype(jnp.float32))
+
+
+def make_monotonic_bounds(y) -> Tuple[int, int]:
+    """Host helper returning (min_label, max_label) like the reference's
+    ``getInputClassCardinality`` (stats/contingency_matrix.cuh)."""
+    y = as_array(y)
+    return int(jnp.min(y)), int(jnp.max(y))
+
+
+def contingency_matrix(
+    ground_truth,
+    predicted,
+    min_label: Optional[int] = None,
+    max_label: Optional[int] = None,
+) -> jax.Array:
+    """Contingency table of ground-truth vs predicted labels.
+
+    Ref: stats/contingency_matrix.cuh — the reference picks among smem/gmem
+    atomic binning strategies by cardinality; on TPU the table is a one-hot
+    matmul on the MXU (n_classes² accumulators in one dot_general).
+
+    Labels are assumed integer in ``[min_label, max_label]``; out-of-range
+    entries are dropped. Returns ``(n_classes, n_classes)`` int32 with rows =
+    ground truth, cols = predicted.
+    """
+    gt = as_array(ground_truth).astype(jnp.int32)
+    pr = as_array(predicted).astype(jnp.int32)
+    if min_label is None:
+        min_label = int(jnp.min(gt))
+    if max_label is None:
+        max_label = int(jnp.max(gt))
+    n_classes = max_label - min_label + 1
+    g1 = jax.nn.one_hot(gt - min_label, n_classes, dtype=jnp.int32)
+    p1 = jax.nn.one_hot(pr - min_label, n_classes, dtype=jnp.int32)
+    return g1.T @ p1

@@ -1,0 +1,232 @@
+"""Clustering quality metrics.
+
+Ref: cpp/include/raft/stats/{adjusted_rand_index,rand_index,
+mutual_info_score,entropy,homogeneity_score,completeness_score,v_measure,
+kl_divergence,silhouette_score,trustworthiness_score}.cuh.
+
+All the pair-counting metrics reduce to the contingency matrix, which is a
+one-hot matmul on TPU (see :func:`raft_tpu.stats.classification.contingency_matrix`);
+the reference builds the same table with atomic kernels and then reduces it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.stats.classification import contingency_matrix
+
+
+def _contingency(a, b, n_classes: Optional[int] = None) -> jax.Array:
+    """Symmetric-cardinality float contingency table built on
+    :func:`~raft_tpu.stats.classification.contingency_matrix`."""
+    a = as_array(a).astype(jnp.int32)
+    b = as_array(b).astype(jnp.int32)
+    if n_classes is None:
+        n_classes = int(jnp.maximum(jnp.max(a), jnp.max(b))) + 1
+    dtype = jnp.float64 if jax.config.x64_enabled else jnp.float32
+    return contingency_matrix(a, b, min_label=0, max_label=n_classes - 1).astype(dtype)
+
+
+def rand_index(first, second) -> jax.Array:
+    """Rand index between two clusterings (ref: stats/rand_index.cuh).
+
+    RI = (a + b) / C(n,2) with a = agreeing same-cluster pairs, b = agreeing
+    different-cluster pairs. The reference brute-forces all n² pairs
+    (detail/rand_index.cuh); the contingency formulation is equivalent and
+    O(n·k) on the MXU.
+    """
+    a = as_array(first)
+    n = a.shape[0]
+    cm = _contingency(first, second)
+    total_pairs = n * (n - 1) / 2.0
+    sum_sq = jnp.sum(cm**2)
+    sum_rows_sq = jnp.sum(jnp.sum(cm, axis=1) ** 2)
+    sum_cols_sq = jnp.sum(jnp.sum(cm, axis=0) ** 2)
+    # a = Σ C(n_ij,2); b = C(n,2) - Σ C(a_i,2) - Σ C(b_j,2) + Σ C(n_ij,2)
+    #   = C(n,2) - (Σa² + Σb² - Σn² - n)/2
+    a_pairs = (sum_sq - n) / 2.0
+    b_pairs = (
+        total_pairs + (sum_sq - sum_rows_sq - sum_cols_sq + n) / 2.0
+    )
+    return (a_pairs + b_pairs) / total_pairs
+
+
+def adjusted_rand_index(first, second) -> jax.Array:
+    """Adjusted-for-chance Rand index (ref: stats/adjusted_rand_index.cuh).
+
+    ARI = (Σ C(n_ij,2) - E) / (max - E) with
+    E = Σ C(a_i,2)·Σ C(b_j,2)/C(n,2).
+    """
+    a = as_array(first)
+    n = a.shape[0]
+    cm = _contingency(first, second)
+    rows = jnp.sum(cm, axis=1)
+    cols = jnp.sum(cm, axis=0)
+
+    def comb2(x):
+        return jnp.sum(x * (x - 1) / 2.0)
+
+    sum_comb = comb2(cm)
+    sum_comb_rows = comb2(rows)
+    sum_comb_cols = comb2(cols)
+    total = n * (n - 1) / 2.0
+    expected = sum_comb_rows * sum_comb_cols / total
+    max_index = (sum_comb_rows + sum_comb_cols) / 2.0
+    denom = max_index - expected
+    # Identical trivial clusterings (denom == 0) → perfect score 1, matching
+    # sklearn/the reference's behavior.
+    return jnp.where(denom == 0, 1.0, (sum_comb - expected) / jnp.where(denom == 0, 1.0, denom))
+
+
+def mutual_info_score(first, second) -> jax.Array:
+    """Mutual information between two labelings
+    (ref: stats/mutual_info_score.cuh): Σ_ij p_ij·log(p_ij/(p_i·p_j))."""
+    a = as_array(first)
+    n = a.shape[0]
+    cm = _contingency(first, second)
+    p_ij = cm / n
+    p_i = jnp.sum(p_ij, axis=1, keepdims=True)
+    p_j = jnp.sum(p_ij, axis=0, keepdims=True)
+    ratio = p_ij / (p_i * p_j)
+    term = jnp.where(p_ij > 0, p_ij * jnp.log(jnp.where(ratio > 0, ratio, 1.0)), 0.0)
+    return jnp.sum(term)
+
+
+def entropy(labels, n_classes: Optional[int] = None) -> jax.Array:
+    """Shannon entropy (nats) of a labeling (ref: stats/entropy.cuh)."""
+    y = as_array(labels).astype(jnp.int32)
+    n = y.shape[0]
+    if n_classes is None:
+        n_classes = int(jnp.max(y)) + 1
+    counts = jnp.sum(jax.nn.one_hot(y, n_classes, dtype=jnp.float32), axis=0)
+    p = counts / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(jnp.where(p > 0, p, 1.0)), 0.0))
+
+
+def homogeneity_score(truth, predicted) -> jax.Array:
+    """1 - H(C|K)/H(C) (ref: stats/homogeneity_score.cuh — computed from MI
+    and entropies as in the reference's detail impl)."""
+    mi = mutual_info_score(truth, predicted)
+    h_truth = entropy(truth)
+    return jnp.where(h_truth == 0, 1.0, mi / jnp.where(h_truth == 0, 1.0, h_truth))
+
+
+def completeness_score(truth, predicted) -> jax.Array:
+    """1 - H(K|C)/H(K) (ref: stats/completeness_score.cuh)."""
+    mi = mutual_info_score(truth, predicted)
+    h_pred = entropy(predicted)
+    return jnp.where(h_pred == 0, 1.0, mi / jnp.where(h_pred == 0, 1.0, h_pred))
+
+
+def v_measure(truth, predicted, beta: float = 1.0) -> jax.Array:
+    """Weighted harmonic mean of homogeneity and completeness
+    (ref: stats/v_measure.cuh, beta default 1.0)."""
+    h = homogeneity_score(truth, predicted)
+    c = completeness_score(truth, predicted)
+    denom = beta * h + c
+    return jnp.where(denom == 0, 0.0, (1 + beta) * h * c / jnp.where(denom == 0, 1.0, denom))
+
+
+def kl_divergence(modeled_pdf, candidate_pdf) -> jax.Array:
+    """KL divergence Σ p·log(p/q) (ref: stats/kl_divergence.cuh)."""
+    p = as_array(modeled_pdf)
+    q = as_array(candidate_pdf)
+    ratio = jnp.where((p > 0) & (q > 0), p / jnp.where(q > 0, q, 1.0), 1.0)
+    return jnp.sum(jnp.where(p > 0, p * jnp.log(ratio), 0.0))
+
+
+def silhouette_score(
+    X,
+    labels,
+    n_clusters: Optional[int] = None,
+    metric: str = "sqeuclidean",
+    chunk: int = 1024,
+) -> jax.Array:
+    """Mean silhouette coefficient over all samples.
+
+    Ref: stats/silhouette_score.cuh — the reference computes the full
+    pairwise-distance matrix (or batches of it for the batched variant,
+    detail/batched/silhouette_score.cuh) and reduces per-cluster average
+    distances. Here the per-cluster sums are one matmul: ``D @ onehot(labels)``
+    rides the MXU, and ``chunk`` rows of D are materialized at a time (the
+    batched variant's memory bound).
+    """
+    from raft_tpu.distance import pairwise_distance
+
+    x = as_array(X)
+    y = as_array(labels).astype(jnp.int32)
+    n = x.shape[0]
+    if n_clusters is None:
+        n_clusters = int(jnp.max(y)) + 1
+    onehot = jax.nn.one_hot(y, n_clusters, dtype=x.dtype)  # (n, k)
+    counts = jnp.sum(onehot, axis=0)  # (k,)
+
+    n_chunks = (n + chunk - 1) // chunk
+    pad = n_chunks * chunk - n
+    xp = jnp.concatenate([x, jnp.zeros((pad, x.shape[1]), x.dtype)], axis=0) if pad else x
+
+    def scan_body(_, start):
+        xb = jax.lax.dynamic_slice_in_dim(xp, start, chunk, axis=0)
+        d = pairwise_distance(xb, x, metric=metric)
+        return None, d @ onehot
+
+    starts = jnp.arange(n_chunks) * chunk
+    _, sums = jax.lax.scan(scan_body, None, starts)
+    sums = sums.reshape(n_chunks * chunk, n_clusters)[:n]  # (n, k)
+
+    own = onehot.astype(bool)  # (n, k)
+    own_count = counts[y]  # cluster size of each sample
+    # a(i): mean intra-cluster distance excluding self (d(i,i)=0 in the sum).
+    a_sum = jnp.sum(jnp.where(own, sums, 0.0), axis=1)
+    a = jnp.where(own_count > 1, a_sum / jnp.maximum(own_count - 1, 1), 0.0)
+    # b(i): min over other *non-empty* clusters of mean distance (empty
+    # cluster ids would otherwise contribute a bogus 0 mean).
+    excluded = own | (counts[None, :] == 0)
+    mean_other = jnp.where(excluded, jnp.inf, sums / jnp.maximum(counts[None, :], 1))
+    b = jnp.min(mean_other, axis=1)
+    sil = jnp.where(own_count > 1, (b - a) / jnp.maximum(jnp.maximum(a, b), 1e-30), 0.0)
+    return jnp.mean(sil)
+
+
+def trustworthiness_score(
+    X,
+    X_embedded,
+    n_neighbors: int,
+    metric: str = "sqeuclidean",
+    batch_size: int = 512,
+) -> jax.Array:
+    """How much local structure of ``X`` is retained in ``X_embedded``.
+
+    Ref: stats/trustworthiness_score.cuh (detail at
+    detail/trustworthiness_score.cuh:129-215): kNN in the embedding
+    (n_neighbors+1 including self), full-rank ordering in the original space
+    via per-row argsort of pairwise distances, penalty
+    ``max(0, rank - n_neighbors)`` per embedded neighbor where ``rank`` is the
+    0-based position in the original ordering (self at 0), then
+    ``1 - 2·Σpenalty / (n·k·(2n - 3k - 1))``.
+    """
+    from raft_tpu.distance import pairwise_distance
+
+    x = as_array(X)
+    e = as_array(X_embedded)
+    n = x.shape[0]
+    k = n_neighbors
+
+    # kNN in embedding space, k+1 to include self (ref: run_knn, :100-115).
+    d_emb = pairwise_distance(e, e, metric=metric)
+    _, emb_ind = jax.lax.top_k(-d_emb, k + 1)  # (n, k+1)
+
+    # Original-space rank lookup: rank[i, j] = position of j in row i's
+    # distance ordering (ref: build_lookup_table :36-46).
+    d_x = pairwise_distance(x, x, metric=metric)
+    order = jnp.argsort(d_x, axis=1)  # (n, n) — column j of row i gives sample at rank j
+    ranks = jnp.zeros_like(order).at[jnp.arange(n)[:, None], order].set(jnp.arange(n)[None, :])
+
+    r = jnp.take_along_axis(ranks, emb_ind, axis=1)  # (n, k+1)
+    penalty = jnp.maximum(r - k, 0)  # self has rank 0 → never penalized
+    t = jnp.sum(penalty).astype(jnp.float64 if jax.config.x64_enabled else jnp.float32)
+    return 1.0 - (2.0 / (n * k * (2.0 * n - 3.0 * k - 1.0))) * t

@@ -1,0 +1,189 @@
+"""Descriptive statistics over matrices.
+
+Ref: cpp/include/raft/stats/{mean,meanvar,stddev,sum,cov,minmax,
+weighted_mean,mean_center,histogram,dispersion}.cuh. The reference's
+shared-memory / global-atomic kernel strategies collapse into single XLA
+reductions on TPU — reductions over the sample axis vectorize on the VPU and
+covariance rides the MXU via a gram matmul.
+
+Convention (matches the reference's mdspan APIs): data matrices are
+``(n_samples, n_features)`` row-major; column-wise statistics (one value per
+feature) are the default, mirroring the reference's ``rowMajor=true`` call
+pattern used throughout cuML.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+def mean(data, sample: bool = False, axis: int = 0) -> jax.Array:
+    """Column-wise mean (ref: stats/mean.cuh ``raft::stats::mean``).
+
+    ``sample=True`` divides by ``N-1`` instead of ``N`` (the reference's
+    ``sample`` flag).
+    """
+    x = as_array(data)
+    n = x.shape[axis]
+    denom = (n - 1) if sample else n
+    return jnp.sum(x, axis=axis) / denom
+
+
+def sum_(data, axis: int = 0) -> jax.Array:
+    """Column-wise sum (ref: stats/sum.cuh)."""
+    return jnp.sum(as_array(data), axis=axis)
+
+
+def meanvar(
+    data, sample: bool = True, axis: int = 0
+) -> Tuple[jax.Array, jax.Array]:
+    """Mean and variance in one pass (ref: stats/meanvar.cuh).
+
+    Returns ``(mean, var)``; ``sample=True`` → unbiased variance (N-1).
+    """
+    x = as_array(data)
+    n = x.shape[axis]
+    mu = jnp.mean(x, axis=axis)
+    # Two-pass formulation: numerically safer than E[x²]-E[x]² (the expanded
+    # form the reference uses risks catastrophic cancellation; XLA fuses the
+    # two passes anyway).
+    var = jnp.sum((x - jnp.expand_dims(mu, axis)) ** 2, axis=axis)
+    var = var / ((n - 1) if sample else n)
+    return mu, var
+
+
+def vars_(data, mu=None, sample: bool = True, axis: int = 0) -> jax.Array:
+    """Column-wise variance about ``mu`` (ref: stats/stddev.cuh ``vars``)."""
+    x = as_array(data)
+    n = x.shape[axis]
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    v = jnp.sum((x - jnp.expand_dims(as_array(mu), axis)) ** 2, axis=axis)
+    return v / ((n - 1) if sample else n)
+
+
+def stddev(data, mu=None, sample: bool = True, axis: int = 0) -> jax.Array:
+    """Column-wise standard deviation (ref: stats/stddev.cuh)."""
+    return jnp.sqrt(vars_(data, mu=mu, sample=sample, axis=axis))
+
+
+def mean_center(data, mu=None, axis: int = 0) -> jax.Array:
+    """Subtract the (column) mean (ref: stats/mean_center.cuh)."""
+    x = as_array(data)
+    if mu is None:
+        mu = jnp.mean(x, axis=axis)
+    return x - jnp.expand_dims(as_array(mu), axis)
+
+
+def mean_add(data, mu, axis: int = 0) -> jax.Array:
+    """Add the (column) mean back (ref: stats/mean_center.cuh ``meanAdd``)."""
+    return as_array(data) + jnp.expand_dims(as_array(mu), axis)
+
+
+def cov(
+    data,
+    mu=None,
+    sample: bool = True,
+    stable: bool = True,
+) -> jax.Array:
+    """Covariance matrix of ``(n_samples, n_features)`` data.
+
+    Ref: stats/cov.cuh — the reference computes ``x̄ᵀ x̄ / denom`` with a gemm
+    after mean-centering (``stable=true``) or uses the expanded form. On TPU
+    the centered gemm is one MXU matmul.
+    """
+    x = as_array(data)
+    n = x.shape[0]
+    denom = (n - 1) if sample else n
+    if stable:
+        xc = mean_center(x, mu=mu)
+        return (xc.T @ xc) / denom
+    if mu is None:
+        mu = jnp.mean(x, axis=0)
+    mu = as_array(mu)
+    return (x.T @ x) / denom - jnp.outer(mu, mu) * (n / denom)
+
+
+def minmax(data, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
+    """Column-wise (min, max) (ref: stats/minmax.cuh)."""
+    x = as_array(data)
+    return jnp.min(x, axis=axis), jnp.max(x, axis=axis)
+
+
+def weighted_mean(data, weights, axis: int = 0) -> jax.Array:
+    """Weighted mean along ``axis`` with weights per sample
+    (ref: stats/weighted_mean.cuh)."""
+    x = as_array(data)
+    w = as_array(weights, dtype=x.dtype)
+    wsum = jnp.sum(w)
+    w = jnp.expand_dims(w, 1 - axis) if x.ndim == 2 else w
+    return jnp.sum(x * w, axis=axis) / wsum
+
+
+def row_weighted_mean(data, weights) -> jax.Array:
+    """Per-row weighted mean over columns, weights of length n_cols
+    (ref: stats/weighted_mean.cuh ``rowWeightedMean``)."""
+    x = as_array(data)
+    w = as_array(weights, dtype=x.dtype)
+    return (x @ w) / jnp.sum(w)
+
+
+def col_weighted_mean(data, weights) -> jax.Array:
+    """Per-column weighted mean over rows, weights of length n_rows
+    (ref: stats/weighted_mean.cuh ``colWeightedMean``)."""
+    x = as_array(data)
+    w = as_array(weights, dtype=x.dtype)
+    return (w @ x) / jnp.sum(w)
+
+
+def histogram(
+    data,
+    n_bins: int,
+    lower: Optional[float] = None,
+    upper: Optional[float] = None,
+) -> jax.Array:
+    """Per-column histogram of ``(n_samples, n_cols)`` data.
+
+    Ref: stats/histogram.cuh — the reference picks among gmem/smem atomic
+    binning strategies (``HistType``); on TPU binning is a one-hot matmul /
+    segment-sum, so a single implementation serves all shapes. Values are
+    binned into ``n_bins`` equal-width bins over ``[lower, upper)`` (data
+    range when not given, like the reference's caller-computed bin edges).
+
+    Returns ``(n_bins, n_cols)`` int32 counts.
+    """
+    x = as_array(data)
+    if x.ndim == 1:
+        x = x[:, None]
+    lo = jnp.min(x) if lower is None else jnp.asarray(lower, x.dtype)
+    hi = jnp.max(x) if upper is None else jnp.asarray(upper, x.dtype)
+    width = (hi - lo) / n_bins
+    width = jnp.where(width == 0, 1, width)
+    bins = jnp.clip(((x - lo) / width).astype(jnp.int32), 0, n_bins - 1)
+    onehot = jax.nn.one_hot(bins, n_bins, dtype=jnp.int32, axis=0)
+    return jnp.sum(onehot, axis=1)
+
+
+def dispersion(
+    centroids,
+    cluster_sizes,
+    n_points: Optional[int] = None,
+) -> jax.Array:
+    """Cluster dispersion metric for auto-k selection.
+
+    Ref: stats/dispersion.cuh (detail/dispersion.cuh:53-97): the size-weighted
+    global centroid ``mu = Σ sizeᵢ·cᵢ / n_points``, then
+    ``sqrt( Σᵢ sizeᵢ · ||cᵢ - mu||² )``.
+    """
+    c = as_array(centroids)
+    sizes = as_array(cluster_sizes)
+    if n_points is None:
+        n_points = jnp.sum(sizes)
+    mu = (sizes.astype(c.dtype) @ c) / n_points
+    d2 = jnp.sum((c - mu[None, :]) ** 2, axis=1)
+    return jnp.sqrt(jnp.sum(sizes.astype(c.dtype) * d2))

@@ -1,0 +1,79 @@
+"""Regression quality metrics.
+
+Ref: cpp/include/raft/stats/{r2_score,regression_metrics,
+information_criterion}.cuh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.mdarray import as_array
+
+
+class InformationCriterionType(enum.Enum):
+    """Ref: IC_Type {AIC, AICc, BIC} (stats/stats_types.hpp:72)."""
+
+    AIC = 0
+    AICc = 1
+    BIC = 2
+
+
+def r2_score(y, y_hat) -> jax.Array:
+    """Coefficient of determination R² (ref: stats/r2_score.cuh).
+
+    ``1 - SS_res / SS_tot`` with SS_tot about the mean of ``y``.
+    """
+    yt = as_array(y)
+    yp = as_array(y_hat)
+    mu = jnp.mean(yt)
+    ss_tot = jnp.sum((yt - mu) ** 2)
+    ss_res = jnp.sum((yt - yp) ** 2)
+    return 1.0 - ss_res / ss_tot
+
+
+def regression_metrics(predictions, ref_predictions) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Mean-absolute, mean-squared and median-absolute error.
+
+    Ref: stats/regression_metrics.cuh ``regression_metrics`` — returns the
+    same triple (the reference computes the median via a device sort; here a
+    single ``jnp.median``).
+    """
+    p = as_array(predictions)
+    r = as_array(ref_predictions)
+    diff = p - r
+    mean_abs = jnp.mean(jnp.abs(diff))
+    mean_sq = jnp.mean(diff**2)
+    median_abs = jnp.median(jnp.abs(diff))
+    return mean_abs, mean_sq, median_abs
+
+
+def information_criterion(
+    loglikelihood,
+    ic_type: InformationCriterionType,
+    n_params: int,
+    n_samples: int,
+) -> jax.Array:
+    """Batched information criterion from per-series log-likelihoods.
+
+    Ref: stats/information_criterion.cuh →
+    detail/batched/information_criterion.cuh: AIC = 2k - 2ll;
+    AICc = AIC + 2k(k+1)/(N-k-1); BIC = k·ln(N) - 2ll.
+    """
+    ll = as_array(loglikelihood)
+    k = n_params
+    n = n_samples
+    base = -2.0 * ll
+    if ic_type == InformationCriterionType.AIC:
+        penalty = 2.0 * k
+    elif ic_type == InformationCriterionType.AICc:
+        penalty = 2.0 * k + (2.0 * k * (k + 1)) / (n - k - 1)
+    elif ic_type == InformationCriterionType.BIC:
+        penalty = k * jnp.log(jnp.asarray(float(n), ll.dtype))
+    else:  # pragma: no cover
+        raise ValueError(f"unknown IC type {ic_type}")
+    return base + penalty

@@ -188,3 +188,24 @@ class TestDecomp:
         np.testing.assert_allclose(
             np.asarray(l_up) @ np.asarray(l_up).T, spd + np.outer(v, v), atol=1e-3
         )
+
+
+def test_lstsq_multi_target(rng):
+    """Regression: 2-D (multi-target) b must scale along the right axis."""
+    from raft_tpu.linalg import lstsq_svd, lstsq_eig
+
+    a = rng.standard_normal((12, 4)).astype(np.float32)
+    b = rng.standard_normal((12, 3)).astype(np.float32)
+    want, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(np.asarray(lstsq_svd(a, b)), want, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(lstsq_eig(a, b)), want, rtol=1e-2, atol=1e-3)
+
+
+def test_coalesced_reduction_custom_op():
+    """Regression: custom reduce ops must work with the negative-axis path."""
+    from raft_tpu.linalg import coalesced_reduction
+    from raft_tpu.core import operators as ops
+
+    x = jnp.asarray([[1.0, 2.0, 3.0], [2.0, 2.0, 2.0]])
+    got = coalesced_reduction(x, reduce_op=ops.mul_op, init=1.0)
+    np.testing.assert_allclose(np.asarray(got), [6.0, 8.0])

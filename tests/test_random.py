@@ -145,3 +145,14 @@ class TestRmat:
         assert np.asarray(src).max() < 16
         assert np.asarray(dst).max() < 256
         assert np.asarray(dst).max() >= 16  # actually uses the col range
+
+
+def test_rmat_oversized_theta():
+    """Regression: theta with more rows than depth is sliced, not crashed."""
+    from raft_tpu.random import rmat_rectangular_gen
+    from raft_tpu.random.rng_state import RngState
+
+    theta = np.full((4, 4), 0.25, np.float32)
+    src, dst = rmat_rectangular_gen(RngState(1), theta, r_scale=3, c_scale=3, n_edges=10)
+    assert src.shape == (10,) and dst.shape == (10,)
+    assert int(np.max(np.asarray(src))) < 8 and int(np.max(np.asarray(dst))) < 8

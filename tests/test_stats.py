@@ -1,0 +1,219 @@
+"""Tests for raft_tpu.stats vs numpy / scikit-learn ground truth
+(ref test style: cpp/test/stats/*.cu compare vs host re-implementations)."""
+
+import numpy as np
+import pytest
+import scipy.stats
+import sklearn.metrics
+from sklearn.manifold import trustworthiness as sk_trustworthiness
+
+import raft_tpu.stats as stats
+from raft_tpu.stats.regression import InformationCriterionType
+
+
+def _labels(rng, n=200, k=5):
+    return rng.integers(0, k, n), rng.integers(0, k, n)
+
+
+# -- descriptive ------------------------------------------------------------
+
+
+def test_mean_sum_meanvar_stddev(rng):
+    x = rng.standard_normal((40, 7)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(stats.mean(x)), x.mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(stats.sum(x)), x.sum(0), rtol=1e-5, atol=1e-5)
+    mu, var = stats.meanvar(x, sample=True)
+    np.testing.assert_allclose(np.asarray(mu), x.mean(0), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(var), x.var(0, ddof=1), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats.stddev(x)), x.std(0, ddof=1), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_mean_center_add(rng):
+    x = rng.standard_normal((30, 4)).astype(np.float32)
+    c = stats.mean_center(x)
+    np.testing.assert_allclose(np.asarray(c), x - x.mean(0), rtol=1e-5, atol=1e-6)
+    back = stats.mean_add(c, x.mean(0))
+    np.testing.assert_allclose(np.asarray(back), x, rtol=1e-5, atol=1e-6)
+
+
+def test_cov(rng):
+    x = rng.standard_normal((60, 5)).astype(np.float32)
+    want = np.cov(x, rowvar=False)
+    np.testing.assert_allclose(np.asarray(stats.cov(x)), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(stats.cov(x, stable=False)), want, rtol=1e-3, atol=1e-4
+    )
+
+
+def test_minmax_weighted_mean(rng):
+    x = rng.standard_normal((25, 6)).astype(np.float32)
+    lo, hi = stats.minmax(x)
+    np.testing.assert_allclose(np.asarray(lo), x.min(0))
+    np.testing.assert_allclose(np.asarray(hi), x.max(0))
+    w = rng.random(25).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(stats.col_weighted_mean(x, w)),
+        (w[:, None] * x).sum(0) / w.sum(),
+        rtol=1e-4, atol=1e-5,
+    )
+    wc = rng.random(6).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(stats.row_weighted_mean(x, wc)),
+        (x * wc).sum(1) / wc.sum(),
+        rtol=1e-4, atol=1e-5,
+    )
+
+
+def test_histogram(rng):
+    x = rng.random((100, 3)).astype(np.float32)
+    h = np.asarray(stats.histogram(x, n_bins=8, lower=0.0, upper=1.0))
+    assert h.shape == (8, 3)
+    for c in range(3):
+        want, _ = np.histogram(x[:, c], bins=8, range=(0.0, 1.0))
+        np.testing.assert_array_equal(h[:, c], want)
+
+
+def test_dispersion(rng):
+    centroids = rng.standard_normal((4, 3)).astype(np.float32)
+    sizes = np.array([10, 20, 5, 15])
+    mu = (sizes[:, None] * centroids).sum(0) / sizes.sum()
+    want = np.sqrt((sizes * ((centroids - mu) ** 2).sum(1)).sum())
+    got = np.asarray(stats.dispersion(centroids, sizes))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+# -- regression -------------------------------------------------------------
+
+
+def test_r2_and_regression_metrics(rng):
+    y = rng.standard_normal(100).astype(np.float32)
+    yp = y + 0.1 * rng.standard_normal(100).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(stats.r2_score(y, yp)),
+        sklearn.metrics.r2_score(y, yp),
+        rtol=1e-3,
+    )
+    ma, ms, md = stats.regression_metrics(yp, y)
+    d = yp - y
+    np.testing.assert_allclose(np.asarray(ma), np.abs(d).mean(), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(ms), (d**2).mean(), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(md), np.median(np.abs(d)), rtol=1e-4)
+
+
+def test_information_criterion():
+    ll = np.array([-120.0, -80.0], dtype=np.float32)
+    k, n = 3, 100
+    aic = np.asarray(stats.information_criterion(ll, InformationCriterionType.AIC, k, n))
+    np.testing.assert_allclose(aic, -2 * ll + 2 * k)
+    aicc = np.asarray(stats.information_criterion(ll, InformationCriterionType.AICc, k, n))
+    np.testing.assert_allclose(aicc, -2 * ll + 2 * k + 2 * k * (k + 1) / (n - k - 1))
+    bic = np.asarray(stats.information_criterion(ll, InformationCriterionType.BIC, k, n))
+    np.testing.assert_allclose(bic, -2 * ll + k * np.log(n), rtol=1e-6)
+
+
+# -- classification ---------------------------------------------------------
+
+
+def test_accuracy_contingency(rng):
+    a, b = _labels(rng)
+    np.testing.assert_allclose(
+        np.asarray(stats.accuracy(a, b)), (a == b).mean(), rtol=1e-6
+    )
+    cm = np.asarray(stats.contingency_matrix(a, b))
+    want = sklearn.metrics.cluster.contingency_matrix(a, b)
+    np.testing.assert_array_equal(cm, want)
+
+
+# -- cluster metrics --------------------------------------------------------
+
+
+def test_rand_indexes(rng):
+    a, b = _labels(rng)
+    np.testing.assert_allclose(
+        np.asarray(stats.rand_index(a, b)), sklearn.metrics.rand_score(a, b), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.adjusted_rand_index(a, b)),
+        sklearn.metrics.adjusted_rand_score(a, b),
+        rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(np.asarray(stats.adjusted_rand_index(a, a)), 1.0)
+
+
+def test_information_metrics(rng):
+    a, b = _labels(rng)
+    np.testing.assert_allclose(
+        np.asarray(stats.mutual_info_score(a, b)),
+        sklearn.metrics.mutual_info_score(a, b),
+        rtol=1e-4, atol=1e-5,
+    )
+    counts = np.bincount(a)
+    np.testing.assert_allclose(
+        np.asarray(stats.entropy(a)),
+        scipy.stats.entropy(counts),
+        rtol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.homogeneity_score(a, b)),
+        sklearn.metrics.homogeneity_score(a, b),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.completeness_score(a, b)),
+        sklearn.metrics.completeness_score(a, b),
+        rtol=1e-3, atol=1e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats.v_measure(a, b)),
+        sklearn.metrics.v_measure_score(a, b),
+        rtol=1e-3, atol=1e-4,
+    )
+
+
+def test_kl_divergence(rng):
+    p = rng.random(50).astype(np.float32)
+    q = rng.random(50).astype(np.float32)
+    p /= p.sum()
+    q /= q.sum()
+    np.testing.assert_allclose(
+        np.asarray(stats.kl_divergence(p, q)),
+        scipy.stats.entropy(p, q),
+        rtol=1e-3, atol=1e-5,
+    )
+
+
+def test_silhouette_score(rng):
+    x = np.concatenate(
+        [rng.standard_normal((30, 4)) + 4 * i for i in range(3)]
+    ).astype(np.float32)
+    y = np.repeat(np.arange(3), 30)
+    got = np.asarray(stats.silhouette_score(x, y, metric="euclidean"))
+    want = sklearn.metrics.silhouette_score(x, y)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_silhouette_score_chunked(rng):
+    x = rng.standard_normal((45, 3)).astype(np.float32)
+    y = rng.integers(0, 4, 45)
+    got = np.asarray(stats.silhouette_score(x, y, metric="euclidean", chunk=16))
+    want = sklearn.metrics.silhouette_score(x, y)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_trustworthiness(rng):
+    x = rng.standard_normal((80, 10)).astype(np.float32)
+    emb = x[:, :2] + 0.01 * rng.standard_normal((80, 2)).astype(np.float32)
+    got = np.asarray(stats.trustworthiness_score(x, emb, n_neighbors=5))
+    want = sk_trustworthiness(x, emb, n_neighbors=5)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+def test_silhouette_empty_cluster(rng):
+    """Regression: an empty cluster id must not poison b(i) with 0 means."""
+    x = rng.standard_normal((40, 3)).astype(np.float32)
+    y = rng.integers(0, 2, 40) * 2  # labels in {0, 2}; cluster 1 empty
+    got = np.asarray(stats.silhouette_score(x, y, n_clusters=3, metric="euclidean"))
+    want = sklearn.metrics.silhouette_score(x, y)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
