@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Headline benchmark: brute-force k-NN QPS (fused L2 + top-k) on SIFT-like
+data — BASELINE.json config #2.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference repo publishes no benchmark numbers (BASELINE.md — RAFT 23.04
+has only gbench microbenchmarks, no results tables), so ``vs_baseline``
+compares against a CPU/NumPy exact-kNN implementation of the same workload
+measured in-process — the honest available baseline on this hardware.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
+    """SIFT-10K-shaped synthetic data (uint8-range descriptors)."""
+    rng = np.random.default_rng(seed)
+    db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
+    q = rng.integers(0, 256, size=(n_q, dim)).astype(np.float32)
+    return db, q
+
+
+def _numpy_knn_qps(db, q, k, reps=3):
+    def run():
+        d = (
+            (q * q).sum(1)[:, None]
+            + (db * db).sum(1)[None, :]
+            - 2.0 * q @ db.T
+        )
+        idx = np.argpartition(d, k, axis=1)[:, :k]
+        return idx
+
+    run()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        run()
+    dt = (time.perf_counter() - t0) / reps
+    return q.shape[0] / dt
+
+
+def main():
+    import jax
+
+    from raft_tpu.neighbors import brute_force
+
+    k = 10
+    db_h, q_h = _sift_like()
+    db = jax.device_put(db_h)
+    q = jax.device_put(q_h)
+
+    # Warmup (compile) then timed runs.
+    d, i = brute_force.knn(db, q, k)
+    jax.block_until_ready((d, i))
+    reps = 10
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        d, i = brute_force.knn(db, q, k)
+        jax.block_until_ready((d, i))
+    dt = (time.perf_counter() - t0) / reps
+    qps = q.shape[0] / dt
+
+    # Correctness gate: recall@10 == 1.0 vs exact NumPy ground truth.
+    dn = ((q_h[:, None, :] - db_h[None]) ** 2).sum(-1)
+    truth = np.argsort(dn, axis=1)[:, :k]
+    found = np.asarray(i)
+    hits = sum(len(np.intersect1d(found[r], truth[r])) for r in range(q_h.shape[0]))
+    recall = hits / truth.size
+    if recall < 0.999:
+        print(json.dumps({"metric": "bf_knn_sift10k_qps", "value": 0.0,
+                          "unit": "qps", "vs_baseline": 0.0,
+                          "error": f"recall {recall:.4f} < 1.0"}))
+        sys.exit(1)
+
+    cpu_qps = _numpy_knn_qps(db_h, q_h, k)
+    print(json.dumps({
+        "metric": "bf_knn_sift10k_qps",
+        "value": round(qps, 1),
+        "unit": "qps",
+        "vs_baseline": round(qps / cpu_qps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+"""Parameter structs for k-means variants.
+
+Ref: cpp/include/raft/cluster/kmeans_types.hpp (``KMeansParams``) and
+cpp/include/raft/cluster/kmeans_balanced_types.hpp
+(``kmeans_balanced_params``). Field names and defaults are preserved 1:1 for
+parity; the structs are plain dataclasses (the reference has no runtime flag
+system either — everything is per-call params, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Optional
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.random.rng_state import RngState
+
+
+class InitMethod(enum.Enum):
+    """Centroid seeding method (ref: KMeansParams::InitMethod,
+    cluster/kmeans_types.hpp)."""
+
+    KMeansPlusPlus = 0
+    Random = 1
+    Array = 2
+
+
+@dataclass
+class KMeansParams:
+    """Ref: raft::cluster::KMeansParams (cluster/kmeans_types.hpp).
+
+    ``batch_samples``/``batch_centroids`` bound the tile sizes of the
+    assignment step (mini-batching, ref: detail/kmeans.cuh:854); 0 means
+    "use everything at once".
+    """
+
+    n_clusters: int = 8
+    init: InitMethod = InitMethod.KMeansPlusPlus
+    max_iter: int = 300
+    tol: float = 1e-4
+    verbosity: int = 0
+    rng_state: RngState = field(default_factory=lambda: RngState(seed=0))
+    metric: DistanceType = DistanceType.L2Expanded
+    n_init: int = 1
+    oversampling_factor: float = 2.0
+    batch_samples: int = 1 << 15
+    batch_centroids: int = 0
+    inertia_check: bool = False
+
+
+@dataclass
+class KMeansBalancedParams:
+    """Ref: raft::cluster::kmeans_balanced_params
+    (cluster/kmeans_balanced_types.hpp): n_iters + metric only; balancing is
+    algorithmic, not parameterized."""
+
+    n_iters: int = 20
+    metric: DistanceType = DistanceType.L2Expanded
+    rng_state: RngState = field(default_factory=lambda: RngState(seed=0))

@@ -1,0 +1,186 @@
+"""Single-linkage agglomerative clustering.
+
+Ref: cpp/include/raft/cluster/single_linkage.cuh (+ types
+single_linkage_types.hpp: ``LinkageDistance {PAIRWISE, KNN_GRAPH}``,
+``linkage_output``) with the detail pipeline in
+cluster/detail/single_linkage.cuh: connectivity graph
+(detail/connectivities.cuh — full pairwise or kNN graph) → MST with
+connected-components fixup (detail/mst.cuh → sparse/solver/mst +
+sparse/neighbors/connect_components) → dendrogram agglomeration + flat
+cluster extraction (detail/agglomerative.cuh).
+
+TPU-native: graph construction and MST run as the jitted device kernels
+built in :mod:`raft_tpu.sparse`; the final dendrogram walk is an inherently
+sequential O(n α(n)) union-find done on host (the reference performs the
+same serialized merge bookkeeping, just on-device).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType
+
+# NOTE: sparse modules are imported lazily inside single_linkage() —
+# cluster ← neighbors ← sparse.neighbors would otherwise form an import
+# cycle (sparse.neighbors also uses the dense brute-force kNN).
+
+
+class LinkageDistance(enum.Enum):
+    """Connectivity construction (ref: single_linkage_types.hpp)."""
+
+    PAIRWISE = 0
+    KNN_GRAPH = 1
+
+
+@dataclass
+class LinkageOutput:
+    """Ref: linkage_output (single_linkage_types.hpp): dendrogram children
+    (n-1, 2), distances, sizes, and flat labels."""
+
+    labels: jax.Array
+    children: np.ndarray
+    distances: np.ndarray
+    sizes: np.ndarray
+    n_clusters: int
+
+
+def _dendrogram(src, dst, w, n: int, n_clusters: int):
+    """Union-find agglomeration over weight-sorted MST edges (ref:
+    detail/agglomerative.cuh build_dendrogram_host + extract_flattened_
+    clusters)."""
+    order = np.argsort(w, kind="stable")
+    # scipy-style node ids: leaves 0..n-1, internal n..2n-2; parent operates
+    # over all 2n-1 nodes.
+    parent = np.arange(2 * n - 1)
+    size = np.ones(2 * n - 1, np.int64)
+    children = np.zeros((max(n - 1, 0), 2), np.int64)
+    distances = np.zeros(max(n - 1, 0), np.float64)
+    sizes = np.zeros(max(n - 1, 0), np.int64)
+
+    def find(a):
+        root = a
+        while parent[root] != root:
+            root = parent[root]
+        while parent[a] != root:
+            parent[a], a = root, parent[a]
+        return root
+
+    merge = 0
+    for e in order:
+        ra, rb = find(src[e]), find(dst[e])
+        if ra == rb:
+            continue
+        new_node = n + merge
+        children[merge] = (ra, rb)
+        distances[merge] = w[e]
+        sz = size[ra] + size[rb]
+        sizes[merge] = sz
+        parent[ra] = new_node
+        parent[rb] = new_node
+        size[new_node] = sz
+        merge += 1
+        if merge == n - 1:
+            break
+
+    # Flat labels: cut the dendrogram at n_clusters by undoing the last
+    # (n_clusters - 1) merges — i.e. only apply the first n - n_clusters.
+    parent2 = np.arange(n)
+
+    def find2(a):
+        while parent2[a] != a:
+            parent2[a] = parent2[parent2[a]]
+            a = parent2[a]
+        return a
+
+    n_merges = max(0, min(merge, n - n_clusters))
+    for e in order:
+        if n_merges == 0:
+            break
+        ra, rb = find2(src[e]), find2(dst[e])
+        if ra == rb:
+            continue
+        parent2[ra] = rb
+        n_merges -= 1
+    roots = np.array([find2(i) for i in range(n)])
+    _, labels = np.unique(roots, return_inverse=True)
+    return labels.astype(np.int32), children[:merge], distances[:merge], sizes[:merge]
+
+
+def single_linkage(
+    X,
+    n_clusters: int,
+    metric: DistanceType = DistanceType.L2SqrtExpanded,
+    dist_type: LinkageDistance = LinkageDistance.KNN_GRAPH,
+    c: int = 15,
+) -> LinkageOutput:
+    """Single-linkage clustering of dense rows.
+
+    Ref: raft::cluster::single_linkage (cluster/single_linkage.cuh; ``c``
+    controls kNN-graph width k = c like the reference's knn connectivity
+    parameter). Returns a :class:`LinkageOutput`.
+    """
+    from raft_tpu.sparse.neighbors import connect_components, knn_graph
+    from raft_tpu.sparse.solver import mst as mst_solver
+
+    X = np.asarray(X, np.float32)
+    n = X.shape[0]
+    expects(1 <= n_clusters <= n, "invalid n_clusters")
+
+    if dist_type == LinkageDistance.PAIRWISE or n <= c + 1:
+        d = ((X[:, None, :] - X[None]) ** 2).sum(-1)
+        if metric == DistanceType.L2SqrtExpanded:
+            d = np.sqrt(d)
+        iu = np.triu_indices(n, 1)
+        rows = iu[0].astype(np.int32)
+        cols = iu[1].astype(np.int32)
+        w = d[iu].astype(np.float32)
+    else:
+        g = knn_graph(X, min(c, n - 1), metric=metric)
+        rows = np.asarray(g.rows)
+        cols = np.asarray(g.cols)
+        w = np.asarray(g.vals)
+        # Connected-components fixup: union extra cross-component edges
+        # until the graph is connected (ref: detail/connectivities.cuh +
+        # connect_components loop).
+        for _ in range(int(np.ceil(np.log2(max(n, 2)))) + 1):
+            comp = _components(rows, cols, n)
+            if len(np.unique(comp)) == 1:
+                break
+            extra = connect_components(X, comp, metric=metric)
+            rows = np.concatenate([rows, np.asarray(extra.rows)])
+            cols = np.concatenate([cols, np.asarray(extra.cols)])
+            w = np.concatenate([w, np.asarray(extra.vals)])
+
+    tree = mst_solver(rows, cols, w, n)
+    src = np.asarray(tree.src)
+    dst = np.asarray(tree.dst)
+    tw = np.asarray(tree.weights)
+    labels, children, distances, sizes = _dendrogram(src, dst, tw, n, n_clusters)
+    return LinkageOutput(
+        labels=jnp.asarray(labels), children=children, distances=distances,
+        sizes=sizes, n_clusters=n_clusters)
+
+
+def _components(rows, cols, n: int) -> np.ndarray:
+    """Host union-find connected components of an edge list."""
+    parent = np.arange(n)
+
+    def find(a):
+        while parent[a] != a:
+            parent[a] = parent[parent[a]]
+            a = parent[a]
+        return a
+
+    for a, b in zip(rows, cols):
+        ra, rb = find(int(a)), find(int(b))
+        if ra != rb:
+            parent[ra] = rb
+    return np.array([find(i) for i in range(n)])

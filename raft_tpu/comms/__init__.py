@@ -1,0 +1,29 @@
+"""Collective communication facade (ref: cpp/include/raft/comms +
+raft/core/comms.hpp)."""
+
+from raft_tpu.comms.comms import (
+    Comms,
+    DatatypeT,
+    OpT,
+    StatusT,
+    build_comms,
+    inject_comms_on_handle,
+)
+from raft_tpu.comms.comms_test import (
+    test_collective_allreduce,
+    test_collective_broadcast,
+    test_collective_reduce,
+    test_collective_allgather,
+    test_collective_reducescatter,
+    test_pointToPoint_simple_send_recv,
+    test_commsplit,
+)
+
+__all__ = [
+    "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
+    "inject_comms_on_handle",
+    "test_collective_allreduce", "test_collective_broadcast",
+    "test_collective_reduce", "test_collective_allgather",
+    "test_collective_reducescatter", "test_pointToPoint_simple_send_recv",
+    "test_commsplit",
+]

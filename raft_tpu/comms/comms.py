@@ -1,0 +1,190 @@
+"""``comms_t``-style collective facade over XLA mesh collectives.
+
+Ref: cpp/include/raft/core/comms.hpp:123-242 (``comms_iface``/``comms_t``:
+get_size/get_rank/comm_split/barrier, allreduce, bcast, reduce, allgather,
+allgatherv, gather, gatherv, reducescatter, device_send/recv/sendrecv,
+group_start/end; ``datatype_t``/``op_t`` enums :33-34; ``status_t`` from
+sync_stream :135) and the NCCL/UCX implementation comms/detail/std_comms.hpp.
+
+TPU-native re-design (SURVEY.md §2.11 mapping): a communicator is a **mesh
+axis**. Methods are designed to be called *inside* ``shard_map`` over a
+``jax.sharding.Mesh`` — each maps 1:1 onto a lax collective riding ICI/DCN:
+
+    allreduce      ⇔ lax.psum / pmin / pmax / pmean
+    allgather      ⇔ lax.all_gather
+    reducescatter  ⇔ lax.psum_scatter
+    bcast          ⇔ all_gather + slice from root
+    device_send/recv ⇔ lax.ppermute
+    comm_split     ⇔ operating on a sub-axis of a multi-axis mesh
+
+There is no NCCL bootstrap to perform: XLA compiles the collectives into the
+program (multi-host bootstrap is ``jax.distributed.initialize``, the analog
+of raft-dask's NCCL clique formation, raft_dask/common/comms.py:170).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class DatatypeT(enum.Enum):
+    """Ref: comms_t::datatype_t (core/comms.hpp:33). JAX arrays carry their
+    dtype; the enum is kept for API parity."""
+
+    CHAR = 0
+    UINT8 = 1
+    INT32 = 2
+    UINT32 = 3
+    INT64 = 4
+    UINT64 = 5
+    FLOAT32 = 6
+    FLOAT64 = 7
+
+
+class OpT(enum.Enum):
+    """Ref: comms_t::op_t (core/comms.hpp:34)."""
+
+    SUM = 0
+    PROD = 1
+    MIN = 2
+    MAX = 3
+
+
+class StatusT(enum.Enum):
+    """Ref: comms_t::status_t (core/comms.hpp:135) — sync_stream outcome."""
+
+    SUCCESS = 0
+    ERROR = 1
+    ABORT = 2
+
+
+@dataclass(frozen=True)
+class Comms:
+    """A communicator bound to one or more mesh axes.
+
+    Use inside ``shard_map``: every collective lowers to an XLA op over the
+    named axes. ``get_rank``/``get_size`` are trace-time collectives too
+    (lax.axis_index / axis size), like the reference's per-rank views of one
+    logical communicator (ref: comms_t facade, core/comms.hpp:242).
+    """
+
+    axis: Union[str, Sequence[str]] = "data"
+    mesh: Optional[jax.sharding.Mesh] = None
+
+    # -- topology ----------------------------------------------------------
+    def get_size(self) -> int:
+        """Ref: comms_t::get_size. Static when a mesh is bound."""
+        if self.mesh is not None:
+            axes = (self.axis,) if isinstance(self.axis, str) else tuple(self.axis)
+            n = 1
+            for a in axes:
+                n *= self.mesh.shape[a]
+            return n
+        return lax.axis_size(self.axis)
+
+    def get_rank(self):
+        """Ref: comms_t::get_rank. Only meaningful inside shard_map."""
+        return lax.axis_index(self.axis)
+
+    def comm_split(self, axis: Union[str, Sequence[str]]) -> "Comms":
+        """Sub-communicator over a different mesh axis (ref:
+        comms_t::comm_split, core/comms.hpp — the reference re-bootstraps
+        NCCL; here a sub-axis of the mesh IS the split)."""
+        return Comms(axis=axis, mesh=self.mesh)
+
+    def barrier(self) -> None:
+        """Ref: comms_t::barrier. XLA programs are data-flow ordered; an
+        explicit barrier is a no-op inside a compiled program."""
+
+    def sync_stream(self, *arrays) -> StatusT:
+        """Ref: comms_t::sync_stream (status-returning async-error probe,
+        core/comms.hpp:290)."""
+        try:
+            for a in arrays:
+                jax.block_until_ready(a)
+            return StatusT.SUCCESS
+        except Exception:  # XLA surfaces collective failures as exceptions
+            return StatusT.ERROR
+
+    # -- collectives (call inside shard_map) -------------------------------
+    def allreduce(self, x, op: OpT = OpT.SUM):
+        """Ref: comms_t::allreduce (core/comms.hpp:344 → ncclAllReduce)."""
+        if op == OpT.SUM:
+            return lax.psum(x, self.axis)
+        if op == OpT.MIN:
+            return lax.pmin(x, self.axis)
+        if op == OpT.MAX:
+            return lax.pmax(x, self.axis)
+        if op == OpT.PROD:
+            return jnp.exp(lax.psum(jnp.log(x), self.axis))
+        raise ValueError(op)
+
+    def allgather(self, x, axis: int = 0, tiled: bool = True):
+        """Ref: comms_t::allgather → ncclAllGather. Returns the concatenation
+        over ranks along ``axis`` (``tiled=False`` stacks a new axis)."""
+        return lax.all_gather(x, self.axis, axis=axis, tiled=tiled)
+
+    def allgatherv(self, x, counts, axis: int = 0):
+        """Ref: comms_t::allgatherv. Under static shapes, shards are padded
+        to the max count by the caller; this gathers the padded shards plus
+        their counts so the caller can mask."""
+        return (lax.all_gather(x, self.axis, axis=axis, tiled=True),
+                lax.all_gather(counts, self.axis))
+
+    def reduce(self, x, root: int = 0, op: OpT = OpT.SUM):
+        """Ref: comms_t::reduce → ncclReduce. All ranks compute the sum (XLA
+        collectives are symmetric); non-root ranks get zeros like the
+        reference leaves their buffers unspecified."""
+        full = self.allreduce(x, op)
+        return jnp.where(lax.axis_index(self.axis) == root, full,
+                         jnp.zeros_like(full))
+
+    def bcast(self, x, root: int = 0):
+        """Ref: comms_t::bcast → ncclBroadcast."""
+        stacked = lax.all_gather(x, self.axis)  # (size, ...)
+        return stacked[root]
+
+    def reducescatter(self, x, op: OpT = OpT.SUM, scatter_axis: int = 0):
+        """Ref: comms_t::reducescatter → ncclReduceScatter."""
+        if op != OpT.SUM:
+            raise ValueError("reducescatter supports SUM (like psum_scatter)")
+        return lax.psum_scatter(x, self.axis, scatter_dimension=scatter_axis,
+                                tiled=True)
+
+    def gather(self, x, root: int = 0, axis: int = 0):
+        """Ref: comms_t::gather. Symmetric all_gather; caller uses the root's
+        view (XLA has no asymmetric gather — the data lands everywhere)."""
+        return lax.all_gather(x, self.axis, axis=axis, tiled=True)
+
+    def device_sendrecv(self, x, dest: int, source: int):
+        """Paired send/recv (ref: comms_t::device_sendrecv,
+        core/comms.hpp) — expressed as a ppermute over the send edges."""
+        size = self.get_size() if self.mesh is not None else lax.axis_size(self.axis)
+        perm = [(i, (i + dest - source) % size) for i in range(size)]
+        return lax.ppermute(x, self.axis, perm)
+
+    def shift(self, x, offset: int = 1):
+        """Ring shift by ``offset`` (the ppermute idiom behind
+        device_multicast_sendrecv-style neighbor exchanges)."""
+        size = self.get_size() if self.mesh is not None else lax.axis_size(self.axis)
+        perm = [(i, (i + offset) % size) for i in range(size)]
+        return lax.ppermute(x, self.axis, perm)
+
+
+def build_comms(mesh: jax.sharding.Mesh, axis: str = "data") -> Comms:
+    """Factory (ref: build_comms_nccl_only, comms/std_comms.hpp:67 — but
+    there is nothing to bootstrap: the mesh IS the clique)."""
+    return Comms(axis=axis, mesh=mesh)
+
+
+def inject_comms_on_handle(handle, comms: Comms) -> None:
+    """Attach a communicator to a Resources handle (ref:
+    raft_dask inject_comms_on_handle, comms_utils.pyx:288 →
+    handle.set_comms)."""
+    handle.set_comms(comms)

@@ -115,7 +115,11 @@ def _jaccard(x, y, precision=DEFAULT_PRECISION) -> jax.Array:
     xn = _row_norms_sq(x)
     yn = _row_norms_sq(y)
     union = xn[:, None] + yn[None, :] - g
-    return 1.0 - jnp.where(union != 0, g / jnp.where(union != 0, union, 1.0), 0.0)
+    # Both-empty rows are identical, not maximally distant (ref:
+    # sparse/distance/detail/bin_distance.cuh:147-156 flips the similarity
+    # when both rows are zero; scipy agrees: jaccard(0, 0) = 0).
+    return jnp.where(union != 0,
+                     1.0 - g / jnp.where(union != 0, union, 1.0), 0.0)
 
 
 def _dice(x, y, precision=DEFAULT_PRECISION) -> jax.Array:
@@ -125,7 +129,9 @@ def _dice(x, y, precision=DEFAULT_PRECISION) -> jax.Array:
     xn = _row_norms_sq(x)
     yn = _row_norms_sq(y)
     denom = xn[:, None] + yn[None, :]
-    return 1.0 - jnp.where(denom != 0, 2.0 * g / jnp.where(denom != 0, denom, 1.0), 0.0)
+    # Both-empty rows → distance 0 (same convention as _jaccard).
+    return jnp.where(denom != 0,
+                     1.0 - 2.0 * g / jnp.where(denom != 0, denom, 1.0), 0.0)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,34 @@
+"""Class-label utilities.
+
+Ref: cpp/include/raft/label/classlabels.cuh — ``getUniquelabels`` (sorted
+distinct labels) and ``make_monotonic`` (remap arbitrary label values onto
+0..n_classes-1).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def get_unique_labels(labels) -> jax.Array:
+    """Sorted distinct label values (ref: getUniquelabels,
+    label/classlabels.cuh). Host-side: the output size is data-dependent."""
+    return jnp.asarray(np.unique(np.asarray(labels)))
+
+
+def make_monotonic(labels, classes=None, zero_based: bool = True) -> Tuple[jax.Array, jax.Array]:
+    """Remap labels onto a dense 0..k-1 (or 1..k) range (ref:
+    make_monotonic, label/classlabels.cuh). Returns (mapped, classes)."""
+    lab = np.asarray(labels)
+    if classes is None:
+        classes = np.unique(lab)
+    else:
+        classes = np.asarray(classes)
+    mapped = np.searchsorted(classes, lab)
+    if not zero_based:
+        mapped = mapped + 1
+    return jnp.asarray(mapped.astype(np.int32)), jnp.asarray(classes)

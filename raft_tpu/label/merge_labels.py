@@ -1,0 +1,51 @@
+"""Merge two labelings through an equivalence mask.
+
+Ref: cpp/include/raft/label/merge_labels.cuh — given labels A and B over
+the same points plus a mask of "core" points, propagate the minimum label
+over the equivalence classes induced by agreeing on masked points (a
+union-find-flavored iterative min-propagation kernel; used by DBSCAN-style
+algorithms downstream).
+
+TPU-native: the propagation is a ``lax.while_loop`` of segment-min hops —
+label_a and label_b induce a bipartite union; iterating min over both
+sides converges in O(log n) rounds like the reference's loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def merge_labels(labels_a, labels_b, mask) -> jax.Array:
+    """Merged labeling: equivalence classes spanned by (labels_a, labels_b)
+    agreement on masked points receive the min label of the class.
+
+    Ref: raft::label::merge_labels (label/merge_labels.cuh). Non-masked
+    points keep ``labels_a``.
+    """
+    a = jnp.asarray(labels_a, jnp.int32)
+    b = jnp.asarray(labels_b, jnp.int32)
+    m = jnp.asarray(mask, jnp.bool_)
+    n = a.shape[0]
+
+    def body(state):
+        lab, changed = state
+        # Min label per b-class among masked points, then pull back.
+        INF = jnp.int32(2**30)
+        contrib = jnp.where(m, lab, INF)
+        min_b = jax.ops.segment_min(contrib, b, num_segments=n)
+        pulled = jnp.where(m, jnp.minimum(lab, min_b[b]), lab)
+        # And the same through a-classes to close the loop.
+        contrib2 = jnp.where(m, pulled, INF)
+        min_a = jax.ops.segment_min(contrib2, a, num_segments=n)
+        new = jnp.where(m, jnp.minimum(pulled, min_a[a]), pulled)
+        return (new, jnp.any(new != lab))
+
+    def cond(state):
+        return state[1]
+
+    lab0 = a
+    lab, _ = lax.while_loop(cond, body, (lab0, jnp.bool_(True)))
+    return lab

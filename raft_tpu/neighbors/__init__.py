@@ -1,0 +1,23 @@
+"""Nearest-neighbor search: brute-force, IVF-Flat, IVF-PQ, refine,
+ball-cover, epsilon neighborhood (ref: cpp/include/raft/neighbors,
+~11,800 LoC CUDA)."""
+
+from raft_tpu.neighbors.ann_types import IndexParams, SearchParams
+from raft_tpu.neighbors import brute_force
+from raft_tpu.neighbors.brute_force import (
+    knn,
+    fused_l2_knn,
+    knn_merge_parts,
+    tiled_brute_force_knn,
+)
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.refine import refine
+from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors_l2sq
+
+__all__ = [
+    "IndexParams", "SearchParams",
+    "brute_force", "knn", "fused_l2_knn", "knn_merge_parts",
+    "tiled_brute_force_knn",
+    "ivf_flat", "ivf_pq", "refine", "eps_neighbors_l2sq",
+]

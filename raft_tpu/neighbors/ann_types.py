@@ -1,0 +1,25 @@
+"""Base parameter types shared by all ANN indexes.
+
+Ref: cpp/include/raft/neighbors/ann_types.hpp — ``index_params{metric,
+metric_arg, add_data_on_build}`` and empty base ``search_params``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from raft_tpu.distance.distance_types import DistanceType
+
+
+@dataclass
+class IndexParams:
+    """Ref: raft::neighbors::ann::index_params (ann_types.hpp)."""
+
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+
+
+@dataclass
+class SearchParams:
+    """Ref: raft::neighbors::ann::search_params (ann_types.hpp)."""

@@ -1,0 +1,50 @@
+"""Epsilon-neighborhood: all pairs within a radius.
+
+Ref: cpp/include/raft/neighbors/epsilon_neighborhood.cuh:48
+(``epsUnexpL2SqNeighborhood``, detail
+spatial/knn/detail/epsilon_neighborhood.cuh:221) — produces a dense boolean
+adjacency matrix plus per-row vertex degrees, used by DBSCAN downstream.
+
+TPU-native: the fused distance-tile + threshold is a single XLA-fused
+expression — the comparison fuses into the matmul epilogue, so only the
+boolean (m, n) adjacency hits HBM (the reference writes the same outputs).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+
+
+def eps_neighbors_l2sq(x, y, eps_sq: float) -> Tuple[jax.Array, jax.Array]:
+    """Boolean adjacency ``adj[i,j] = ||x_i - y_j||² < eps_sq`` and vertex
+    degrees (ref: epsUnexpL2SqNeighborhood, epsilon_neighborhood.cuh:48 —
+    note the reference takes the *squared* radius too).
+
+    Returns ``(adj (m, n) bool, vd (m+1,) int32)`` where ``vd[:m]`` are row
+    degrees and ``vd[m]`` is their total, matching the reference's layout.
+    """
+    x = as_array(x)
+    y = as_array(y)
+    expects(x.ndim == 2 and y.ndim == 2 and x.shape[1] == y.shape[1],
+            "x and y must be matrices with matching n_cols")
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    if not jnp.issubdtype(y.dtype, jnp.floating):
+        y = y.astype(jnp.float32)
+    xn = jnp.sum(x * x, axis=1)
+    yn = jnp.sum(y * y, axis=1)
+    d = jnp.maximum(
+        xn[:, None] + yn[None, :]
+        - 2.0 * jnp.matmul(x, y.T, precision=jax.lax.Precision.HIGHEST),
+        0.0,
+    )
+    adj = d < eps_sq
+    deg = jnp.sum(adj, axis=1, dtype=jnp.int32)
+    vd = jnp.concatenate([deg, jnp.sum(deg, keepdims=True)])
+    return adj, vd

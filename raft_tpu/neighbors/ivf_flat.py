@@ -1,0 +1,356 @@
+"""IVF-Flat: inverted-file index over raw vectors.
+
+Ref: cpp/include/raft/neighbors/ivf_flat.cuh with types/params at
+neighbors/ivf_flat_types.hpp:44-78 (``index_params{n_lists=1024,
+kmeans_n_iters=20, kmeans_trainset_fraction=0.5, adaptive_centers,
+conservative_memory_allocation}``, ``search_params{n_probes=20}``), build at
+detail/ivf_flat_build.cuh:299 (subsample → kmeans_balanced::fit → extend
+fills interleaved lists) and search at detail/ivf_flat_search.cuh
+(coarse top-n_probes over centers, ``interleaved_scan_kernel``:669, select_k
+merge).
+
+TPU-native re-design. The reference stores each list as pointer-chased
+interleaved groups of 32 rows (``kIndexGroupSize``, ivf_flat_types.hpp:42)
+— a SIMT memory-coalescing idiom. Under XLA's static-shape model the lists
+become one dense **capacity-padded tensor** ``data (n_lists, cap, dim)``
+with a per-slot validity mask derived from ``list_sizes`` — balanced k-means
+(the same trainer the reference uses) keeps the padding overhead small. The
+probe scan is a ``lax.scan`` over probe ranks: each step gathers one probed
+list per query, scores it on the MXU (einsum + norms epilogue), and folds a
+running top-k — the role of ``interleaved_scan_kernel`` + warp-select.
+
+``extend`` re-packs with capacity doubling, mirroring the amortized
+reallocation of ``conservative_memory_allocation=false``
+(ivf_flat_types.hpp:65-73).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import as_array
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.random.rng_state import RngState
+from raft_tpu.util.pow2 import ceildiv
+
+
+@dataclass
+class IndexParams:
+    """Ref: ivf_flat::index_params (neighbors/ivf_flat_types.hpp:44-78);
+    field names and defaults preserved."""
+
+    n_lists: int = 1024
+    metric: DistanceType = DistanceType.L2Expanded
+    metric_arg: float = 2.0
+    add_data_on_build: bool = True
+    kmeans_n_iters: int = 20
+    kmeans_trainset_fraction: float = 0.5
+    adaptive_centers: bool = False
+    conservative_memory_allocation: bool = False
+
+
+@dataclass
+class SearchParams:
+    """Ref: ivf_flat::search_params (neighbors/ivf_flat_types.hpp:74-78)."""
+
+    n_probes: int = 20
+
+
+@dataclass
+class Index:
+    """Trained IVF-Flat index (ref: ivf_flat::index,
+    neighbors/ivf_flat_types.hpp:86-230).
+
+    data/indices are capacity-padded: slot j of list l is valid iff
+    ``j < list_sizes[l]``.
+    """
+
+    metric: DistanceType
+    centers: jax.Array          # (n_lists, dim)
+    data: jax.Array             # (n_lists, cap, dim)
+    indices: jax.Array          # (n_lists, cap) int32 — global source row ids
+    list_sizes: jax.Array       # (n_lists,) int32
+    adaptive_centers: bool = False
+    conservative_memory_allocation: bool = False
+
+    @property
+    def n_lists(self) -> int:
+        return self.centers.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.centers.shape[1]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+
+def _as_float(x) -> jax.Array:
+    x = as_array(x)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float32)
+    return x
+
+
+def _pack_lists(
+    X: jax.Array, labels: jax.Array, ids: jax.Array, n_lists: int,
+    min_cap: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Scatter rows into (n_lists, cap, dim) padded storage.
+
+    The role of ``build_index_kernel`` (detail/ivf_flat_build.cuh) without
+    the interleaved-group layout: rows are sorted by list, positions within
+    each list computed from offset prefix sums, then scattered.
+    """
+    n, d = X.shape
+    labels = labels.astype(jnp.int32)
+    counts = jnp.bincount(labels, length=n_lists)
+    cap = int(max(int(jnp.max(counts)), 1, min_cap))
+
+    order = jnp.argsort(labels, stable=True)
+    sorted_labels = labels[order]
+    offsets = jnp.concatenate([jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
+    pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
+
+    data = jnp.zeros((n_lists, cap, d), X.dtype)
+    idx = jnp.full((n_lists, cap), -1, jnp.int32)
+    data = data.at[sorted_labels, pos].set(X[order])
+    idx = idx.at[sorted_labels, pos].set(ids[order].astype(jnp.int32))
+    return data, idx, counts.astype(jnp.int32)
+
+
+def build(params: IndexParams, dataset, handle=None) -> Index:
+    """Train centers (balanced k-means on a subsample) and fill the lists.
+
+    Ref: ivf_flat::build (neighbors/ivf_flat.cuh →
+    detail/ivf_flat_build.cuh:299): subsample ``kmeans_trainset_fraction`` of
+    the rows, ``kmeans_balanced::fit``, then ``extend`` with the full set.
+    """
+    X = as_array(dataset)
+    expects(X.ndim == 2, "dataset must be (n_rows, dim)")
+    n = X.shape[0]
+    expects(n >= params.n_lists, "need at least n_lists rows")
+    Xf = _as_float(X)
+
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = max(params.n_lists, int(n * frac)) if frac < 1.0 else n
+    stride = max(1, n // n_train)
+    trainset = Xf[::stride][:n_train]
+
+    kb = KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters,
+        metric=params.metric,
+        rng_state=RngState(seed=0),
+    )
+    centers = kmeans_balanced.fit(kb, trainset, params.n_lists)
+
+    index = Index(
+        metric=params.metric,
+        centers=centers,
+        data=jnp.zeros((params.n_lists, 1, X.shape[1]), X.dtype),
+        indices=jnp.full((params.n_lists, 1), -1, jnp.int32),
+        list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
+        adaptive_centers=params.adaptive_centers,
+        conservative_memory_allocation=params.conservative_memory_allocation,
+    )
+    if params.add_data_on_build:
+        index = extend(index, X, jnp.arange(n, dtype=jnp.int32))
+    return index
+
+
+def extend(index: Index, new_vectors, new_indices=None) -> Index:
+    """Append vectors to the index (re-pack with capacity growth).
+
+    Ref: ivf_flat::extend (detail/ivf_flat_build.cuh:159). The reference
+    grows each list's allocation amortized; the padded-tensor analog is a
+    re-pack at the doubled capacity when the current one overflows. When
+    ``adaptive_centers`` is set, centers drift to the running mean of their
+    members (ivf_flat_types.hpp:53-58 / build:~250).
+    """
+    X = as_array(new_vectors)
+    expects(X.ndim == 2 and X.shape[1] == index.dim, "dim mismatch")
+    n_new = X.shape[0]
+    if new_indices is None:
+        base = index.size
+        new_indices = jnp.arange(base, base + n_new, dtype=jnp.int32)
+    else:
+        new_indices = as_array(new_indices).astype(jnp.int32)
+
+    labels = kmeans_balanced.predict(
+        KMeansBalancedParams(metric=index.metric), index.centers, _as_float(X)
+    )
+
+    # Merge with existing valid rows, then re-pack (amortized growth: round
+    # capacity to the next power of two unless conservative).
+    old_n = index.size
+    if old_n:
+        cap = index.data.shape[1]
+        slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        valid = slot < index.list_sizes[:, None]
+        old_rows = index.data.reshape(-1, index.dim)[valid.reshape(-1)]
+        old_ids = index.indices.reshape(-1)[valid.reshape(-1)]
+        old_labels = jnp.repeat(
+            jnp.arange(index.n_lists, dtype=jnp.int32), index.list_sizes,
+            total_repeat_length=old_n,
+        )
+        all_rows = jnp.concatenate([old_rows, X.astype(index.data.dtype)])
+        all_ids = jnp.concatenate([old_ids, new_indices])
+        all_labels = jnp.concatenate([old_labels, labels])
+    else:
+        all_rows = X
+        all_ids = new_indices
+        all_labels = labels
+
+    min_cap = 0
+    if not index.conservative_memory_allocation:
+        counts = jnp.bincount(all_labels, length=index.n_lists)
+        min_cap = 1 << max(int(jnp.max(counts)) - 1, 0).bit_length()
+    data, ids, sizes = _pack_lists(all_rows, all_labels, all_ids, index.n_lists, min_cap)
+
+    centers = index.centers
+    if index.adaptive_centers:
+        sums = jax.ops.segment_sum(_as_float(all_rows), all_labels,
+                                   num_segments=index.n_lists)
+        cnt = jnp.maximum(sizes.astype(centers.dtype), 1.0)
+        centers = jnp.where((sizes > 0)[:, None], sums / cnt[:, None], centers)
+
+    return Index(
+        metric=index.metric, centers=centers, data=data, indices=ids,
+        list_sizes=sizes, adaptive_centers=index.adaptive_centers,
+        conservative_memory_allocation=index.conservative_memory_allocation,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _probe_scan(
+    queries, data, data_sq_norms, indices, list_sizes, k: int, inner_is_l2: bool,
+    sqrt: bool, probe_ids=None,
+):
+    """Scan probed lists, fold a running top-k.
+
+    Ref: interleaved_scan_kernel (detail/ivf_flat_search.cuh:669) + the
+    select_k merge (:944). One scan step handles probe-rank j for every
+    query at once: gather list j's block, score on the MXU, merge.
+    """
+    q, d = queries.shape
+    cap = data.shape[1]
+    qn = jnp.sum(queries * queries, axis=1) if inner_is_l2 else None
+    worst = jnp.inf if inner_is_l2 else -jnp.inf
+    slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
+
+    def body(carry, probe_col):
+        best_d, best_i = carry
+        lists = probe_col                       # (q,) list id per query
+        block = data[lists]                     # (q, cap, d)
+        ids = indices[lists]                    # (q, cap)
+        invalid = slot >= list_sizes[lists][:, None]
+        g = jnp.einsum("qd,qcd->qc", queries, block,
+                       precision=lax.Precision.HIGHEST)
+        if inner_is_l2:
+            dn = data_sq_norms[lists]           # (q, cap)
+            dt = jnp.maximum(qn[:, None] + dn - 2.0 * g, 0.0)
+        else:
+            dt = g
+        dt = jnp.where(invalid, worst, dt)
+        cat_d = jnp.concatenate([best_d, dt], axis=1)
+        cat_i = jnp.concatenate([best_i, ids], axis=1)
+        keys = -cat_d if inner_is_l2 else cat_d
+        _, pos = lax.top_k(keys, k)
+        return (jnp.take_along_axis(cat_d, pos, axis=1),
+                jnp.take_along_axis(cat_i, pos, axis=1)), None
+
+    init = (jnp.full((q, k), worst, queries.dtype),
+            jnp.full((q, k), -1, jnp.int32))
+    (best_d, best_i), _ = lax.scan(body, init, probe_ids.T)
+    if inner_is_l2 and sqrt:
+        best_d = jnp.sqrt(best_d)
+    return best_d, best_i
+
+
+def search(
+    params: SearchParams, index: Index, queries, k: int,
+    handle=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search the index: coarse top-n_probes over centers, then scan probed
+    lists. Ref: ivf_flat::search (detail/ivf_flat_search.cuh; pylibraft
+    neighbors/ivf_flat.pyx search). Returns ``(distances, neighbors)``.
+    """
+    Q = _as_float(queries)
+    expects(Q.ndim == 2 and Q.shape[1] == index.dim, "query dim mismatch")
+    n_probes = min(params.n_probes, index.n_lists)
+    k = min(k, max(index.size, 1))
+
+    metric = index.metric
+    inner_is_l2 = metric != DistanceType.InnerProduct
+    sqrt = metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
+
+    # Coarse quantizer: distances to centers + top-n_probes
+    # (ref: select_clusters-analog in ivf_flat_search).
+    centers = index.centers
+    if inner_is_l2:
+        cn = jnp.sum(centers * centers, axis=1)
+        cd = (jnp.sum(Q * Q, axis=1)[:, None] + cn[None, :]
+              - 2.0 * jnp.matmul(Q, centers.T, precision=lax.Precision.HIGHEST))
+        _, probe_ids = select_k(cd, n_probes, select_min=True)
+    else:
+        cd = jnp.matmul(Q, centers.T, precision=lax.Precision.HIGHEST)
+        _, probe_ids = select_k(cd, n_probes, select_min=False)
+
+    dataf = _as_float(index.data)
+    norms = jnp.sum(dataf * dataf, axis=2) if inner_is_l2 else None
+    return _probe_scan(Q, dataf, norms, index.indices, index.list_sizes,
+                       k, inner_is_l2, sqrt, probe_ids=probe_ids)
+
+
+# ---------------------------------------------------------------------------
+# Serialization (ref: detail/ivf_flat_serialize.cuh:34, serialization_version=3;
+# payloads as .npy inside an .npz, matching the reference's mdspan-as-npy
+# convention, core/detail/mdspan_numpy_serializer.hpp).
+
+SERIALIZATION_VERSION = 3
+
+
+def save(filename: str, index: Index) -> None:
+    """Ref: ivf_flat::serialize / pylibraft save (neighbors/ivf_flat.pyx)."""
+    np.savez(
+        filename,
+        version=np.int64(SERIALIZATION_VERSION),
+        metric=np.int64(index.metric.value),
+        adaptive_centers=np.bool_(index.adaptive_centers),
+        conservative=np.bool_(index.conservative_memory_allocation),
+        centers=np.asarray(index.centers),
+        data=np.asarray(index.data),
+        indices=np.asarray(index.indices),
+        list_sizes=np.asarray(index.list_sizes),
+    )
+
+
+def load(filename: str) -> Index:
+    """Ref: ivf_flat::deserialize / pylibraft load."""
+    if not filename.endswith(".npz"):
+        filename = filename + ".npz"
+    with np.load(filename) as z:
+        version = int(z["version"])
+        expects(version == SERIALIZATION_VERSION,
+                f"serialization version mismatch: {version}")
+        return Index(
+            metric=DistanceType(int(z["metric"])),
+            centers=jnp.asarray(z["centers"]),
+            data=jnp.asarray(z["data"]),
+            indices=jnp.asarray(z["indices"]),
+            list_sizes=jnp.asarray(z["list_sizes"]),
+            adaptive_centers=bool(z["adaptive_centers"]),
+            conservative_memory_allocation=bool(z["conservative"]),
+        )

@@ -1,0 +1,8 @@
+"""Multi-device (MNMG-analog) algorithms over a jax.sharding.Mesh
+(ref: the raft-dask + cuML MNMG pattern — shard data across ranks, combine
+with comms collectives, SURVEY.md §2.12 item 4)."""
+
+from raft_tpu.parallel.knn import sharded_knn
+from raft_tpu.parallel.kmeans import sharded_kmeans_fit, sharded_kmeans_step
+
+__all__ = ["sharded_knn", "sharded_kmeans_fit", "sharded_kmeans_step"]

@@ -1,0 +1,87 @@
+"""Multi-device k-means: shard samples, allreduce the sufficient statistics.
+
+Ref pattern: cuML's kmeans-MG built purely from RAFT comms primitives
+(SURVEY.md §2.12 item 4; docs/source/using_comms.rst) — each rank assigns
+its rows to the current centroids, computes local (sum, count) per cluster,
+and an allreduce produces the new global centroids on every rank.
+
+TPU-native: the EM step is one ``shard_map`` body — fused L2 argmin on the
+local shard, ``segment_sum`` for local stats, ``lax.psum`` over the mesh
+axis for the global reduction. The full fit loops the jitted step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
+
+
+def _em_body(axis: str, n_clusters: int):
+    def step(X_local, centroids):
+        dists, labels = fused_l2_nn_min_reduce(X_local, centroids)
+        sums = jax.ops.segment_sum(X_local, labels, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(
+            jnp.ones((X_local.shape[0],), X_local.dtype), labels,
+            num_segments=n_clusters)
+        inertia_local = jnp.sum(dists)
+        # Global sufficient statistics over ICI (ref: allreduce of
+        # sums/counts in kmeans-MG).
+        sums = lax.psum(sums, axis)
+        counts = lax.psum(counts, axis)
+        inertia = lax.psum(inertia_local, axis)
+        new = sums / jnp.maximum(counts, 1.0)[:, None]
+        new = jnp.where((counts > 0)[:, None], new, centroids)
+        return new, inertia
+
+    return step
+
+
+def sharded_kmeans_step(
+    mesh: Mesh, X, centroids, axis: str = "data"
+) -> Tuple[jax.Array, jax.Array]:
+    """One EM step with X row-sharded over ``mesh[axis]``; returns the new
+    (replicated) centroids and the global inertia."""
+    X = jnp.asarray(X)
+    centroids = jnp.asarray(centroids)
+    k = centroids.shape[0]
+    expects(X.shape[0] % mesh.shape[axis] == 0,
+            "rows must divide the mesh axis (pad first)")
+    fn = shard_map(
+        _em_body(axis, k), mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P()),
+        check_rep=False,
+    )
+    return fn(X, centroids)
+
+
+def sharded_kmeans_fit(
+    mesh: Mesh, X, centroids0, n_iters: int = 20, axis: str = "data"
+) -> Tuple[jax.Array, jax.Array]:
+    """Full distributed Lloyd fit: jit one step over the mesh, loop it.
+
+    Returns ``(centroids, inertia)``, both replicated.
+    """
+    X = jnp.asarray(X)
+    centroids = jnp.asarray(centroids0)
+    k = centroids.shape[0]
+    step = shard_map(
+        _em_body(axis, k), mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P()),
+        check_rep=False,
+    )
+    step = jax.jit(step)
+    inertia = jnp.asarray(jnp.inf, X.dtype)
+    for _ in range(n_iters):
+        centroids, inertia = step(X, centroids)
+    return centroids, inertia

@@ -1,0 +1,71 @@
+"""Multi-device brute-force kNN: shard the database, search locally, merge.
+
+Ref pattern: the reference ships the comms layer + ``knn_merge_parts``
+(neighbors/brute_force.cuh:80) and downstream MNMG kNN shards database rows
+across ranks, searches each shard, and merges the per-rank top-k
+(docs/source/using_comms.rst:1-40; SURVEY.md §2.12 item 4).
+
+TPU-native: one ``shard_map`` over the mesh's data axis — each device scans
+its shard with the fused tiled kernel, then an ``all_gather`` over ICI
+brings the per-shard top-k (k ≪ shard) to every device and a final top-k
+merges. Communication volume is O(n_queries·k·n_devices), never the raw
+shards.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from raft_tpu.core.error import expects
+from raft_tpu.neighbors.brute_force import _tiled_knn_l2
+
+
+def sharded_knn(
+    mesh: Mesh,
+    db,
+    queries,
+    k: int,
+    axis: str = "data",
+    sqrt: bool = False,
+    tile_db: int = 8192,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact L2 kNN with the database row-sharded over ``mesh[axis]``.
+
+    ``db`` rows must be divisible by the axis size (pad upstream if not;
+    static shapes). Returns replicated ``(distances (q,k), indices (q,k))``
+    with global row ids.
+    """
+    db = jnp.asarray(db)
+    queries = jnp.asarray(queries)
+    n_dev = mesh.shape[axis]
+    n, d = db.shape
+    expects(n % n_dev == 0, "db rows must divide the mesh axis (pad first)")
+    shard = n // n_dev
+    kk = min(k, shard)
+    tile = min(tile_db, shard)
+
+    def local_search(db_local, q):
+        # db_local: (shard, d) — this device's rows; q replicated.
+        dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
+        idx = idx + lax.axis_index(axis) * shard           # local → global ids
+        # Merge across devices: gather everyone's top-k, re-select.
+        all_d = lax.all_gather(dist, axis, axis=1, tiled=True)  # (q, n_dev*kk)
+        all_i = lax.all_gather(idx, axis, axis=1, tiled=True)
+        _, pos = lax.top_k(-all_d, min(k, n_dev * kk))
+        return (jnp.take_along_axis(all_d, pos, axis=1),
+                jnp.take_along_axis(all_i, pos, axis=1))
+
+    fn = shard_map(
+        local_search, mesh=mesh,
+        in_specs=(P(axis, None), P(None, None)),
+        out_specs=(P(None, None), P(None, None)),
+        check_rep=False,
+    )
+    return fn(db, queries)

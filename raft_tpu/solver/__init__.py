@@ -1,0 +1,5 @@
+"""Combinatorial solvers (ref: cpp/include/raft/solver)."""
+
+from raft_tpu.solver.linear_assignment import LinearAssignmentProblem, lap
+
+__all__ = ["LinearAssignmentProblem", "lap"]

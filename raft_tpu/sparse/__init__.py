@@ -1,0 +1,13 @@
+"""Sparse formats, ops, distances, kNN and graph solvers
+(ref: cpp/include/raft/sparse, ~12,200 LoC CUDA)."""
+
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse import convert
+from raft_tpu.sparse import op
+from raft_tpu.sparse import linalg
+from raft_tpu.sparse import distance
+from raft_tpu.sparse import neighbors
+from raft_tpu.sparse import solver
+
+__all__ = ["COO", "CSR", "convert", "op", "linalg", "distance",
+           "neighbors", "solver"]

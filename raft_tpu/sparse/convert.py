@@ -1,0 +1,42 @@
+"""Format conversions (ref: cpp/include/raft/sparse/convert/{coo,csr,dense}.hpp)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.sparse.types import COO, CSR, coo_from_dense, csr_from_dense
+
+
+def coo_to_csr(coo: COO) -> CSR:
+    """Ref: sparse/convert/csr.hpp (sorted_coo_to_csr). Rows need not be
+    pre-sorted; a stable sort groups them."""
+    order = jnp.argsort(coo.rows, stable=True)
+    rows = coo.rows[order]
+    counts = jnp.bincount(rows, length=coo.shape[0])
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts).astype(jnp.int32)])
+    return CSR(indptr, coo.cols[order], coo.vals[order], coo.shape)
+
+
+def csr_to_coo(csr: CSR) -> COO:
+    """Ref: sparse/convert/coo.hpp (csr_to_coo)."""
+    return COO(csr.row_ids(), csr.indices, csr.vals, csr.shape)
+
+
+def dense_to_coo(a) -> COO:
+    """Ref: sparse/convert — dense ingestion (host/build path)."""
+    return coo_from_dense(a)
+
+
+def dense_to_csr(a) -> CSR:
+    return csr_from_dense(a)
+
+
+def coo_to_dense(coo: COO) -> jax.Array:
+    return coo.to_dense()
+
+
+def csr_to_dense(csr: CSR) -> jax.Array:
+    """Ref: sparse/convert/dense.hpp (csr_to_dense)."""
+    return csr.to_dense()

@@ -1,0 +1,116 @@
+"""Sparse kNN, kNN-graph construction and connected-components linking.
+
+Ref: cpp/include/raft/sparse/neighbors/brute_force.cuh (block-tiled CSR kNN
+with select_k, detail/knn.cuh), neighbors/knn_graph.cuh (kNN graph as COO),
+neighbors/connect_components.cuh (cross-component nearest neighbors via
+masked NN — the single-linkage fixup).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.distance.distance_types import DistanceType, resolve_metric
+from raft_tpu.neighbors.brute_force import tiled_brute_force_knn
+from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.sparse.distance import pairwise_distance as sparse_pairwise
+from raft_tpu.matrix.select_k import select_k
+from raft_tpu.distance.distance_types import is_min_close
+
+
+def brute_force_knn(
+    idx: CSR, query: CSR, k: int,
+    metric: Union[str, DistanceType] = DistanceType.L2Expanded,
+    metric_arg: float = 2.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Exact kNN between CSR row sets (ref:
+    raft::sparse::neighbors::brute_force_knn, sparse/neighbors/brute_force.cuh
+    — batched pairwise + select_k). Returns (distances, indices)."""
+    metric = resolve_metric(metric)
+    d = sparse_pairwise(query, idx, metric=metric, metric_arg=metric_arg)
+    k = min(k, idx.shape[0])
+    return select_k(d, k, select_min=is_min_close(metric))
+
+
+def knn_graph(
+    X, k: int,
+    metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded,
+) -> COO:
+    """Symmetrized kNN graph over dense rows (ref:
+    raft::sparse::neighbors::knn_graph, sparse/neighbors/knn_graph.cuh — the
+    connectivity builder for single-linkage). Self-edges are dropped.
+    Returns a COO of directed edges (i → each of i's k neighbors)."""
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    metric = resolve_metric(metric)
+    # k+1 then drop self (the nearest neighbor of a point is itself).
+    d, i = tiled_brute_force_knn(X, X, min(k + 1, n), metric=metric)
+    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), i.shape[1])
+    cols = i.reshape(-1)
+    vals = d.reshape(-1)
+    keep = np.asarray(rows != cols)
+    rows_h = np.asarray(rows)[keep]
+    cols_h = np.asarray(cols)[keep]
+    vals_h = np.asarray(vals)[keep]
+    # Trim to exactly k per row where possible (self-match removal leaves
+    # k edges; rows whose self wasn't in the list keep k+1 → drop worst).
+    return COO(jnp.asarray(rows_h), jnp.asarray(cols_h), jnp.asarray(vals_h),
+               (n, n))
+
+
+def connect_components(
+    X, labels, metric: DistanceType = DistanceType.L2SqrtExpanded,
+) -> COO:
+    """Cross-component nearest-neighbor edges (ref:
+    raft::sparse::neighbors::connect_components,
+    sparse/neighbors/connect_components.cuh — masked fused-NN per component;
+    the MST fixup for single-linkage on disconnected kNN graphs).
+
+    For every connected component, finds each point's nearest neighbor
+    *outside its own component* and emits the minimum such edge per
+    component pair candidate set.
+    """
+    X = jnp.asarray(X, jnp.float32)
+    labels = np.asarray(labels)
+    n = X.shape[0]
+    comps = np.unique(labels)
+    if len(comps) <= 1:
+        return COO(jnp.zeros((0,), jnp.int32), jnp.zeros((0,), jnp.int32),
+                   jnp.zeros((0,), X.dtype), (n, n))
+
+    # Masked NN: adjacency mask allows only cross-component pairs
+    # (ref: masked_l2_nn over the component group mask). The (n, n)
+    # distance block comes from the gram epilogue — no (n, n, d) broadcast.
+    lab = jnp.asarray(labels.astype(np.int32))
+    adj = lab[:, None] != lab[None, :]
+    xn = jnp.sum(X * X, axis=1)
+    d = jnp.maximum(
+        xn[:, None] + xn[None, :]
+        - 2.0 * jnp.matmul(X, X.T, precision=jax.lax.Precision.HIGHEST),
+        0.0,
+    )
+    d = jnp.where(adj, d, jnp.inf)
+    nn_idx = jnp.argmin(d, axis=1).astype(jnp.int32)
+    nn_dist = jnp.take_along_axis(d, nn_idx[:, None], axis=1)[:, 0]
+    if metric == DistanceType.L2SqrtExpanded:
+        nn_dist = jnp.sqrt(nn_dist)
+
+    # Keep, per ordered component pair, the single lightest edge — the
+    # reference reduces per-component candidate sets the same way.
+    rows_h = np.arange(n, dtype=np.int32)
+    cols_h = np.asarray(nn_idx)
+    vals_h = np.asarray(nn_dist)
+    pair = labels[rows_h].astype(np.int64) * (labels.max() + 1) + labels[cols_h]
+    best = {}
+    for e in range(n):
+        p = pair[e]
+        if p not in best or vals_h[e] < vals_h[best[p]]:
+            best[p] = e
+    sel = np.array(sorted(best.values()), dtype=np.int64)
+    return COO(jnp.asarray(rows_h[sel]), jnp.asarray(cols_h[sel]),
+               jnp.asarray(vals_h[sel]), (n, n))

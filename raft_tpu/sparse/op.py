@@ -1,0 +1,66 @@
+"""Element/structure ops over sparse matrices
+(ref: cpp/include/raft/sparse/op/{sort, filter, reduce, slice, row_op}.hpp)."""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.sparse.types import COO, CSR
+
+
+def coo_sort(coo: COO) -> COO:
+    """Row-major (row, col) sort (ref: sparse/op/sort.hpp coo_sort)."""
+    n = max(coo.shape[1], 1)
+    key = coo.rows.astype(jnp.int64) * n + coo.cols
+    order = jnp.argsort(key)
+    return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+def remove_zeros(coo: COO) -> COO:
+    """Drop explicit zeros (ref: sparse/op/filter.hpp coo_remove_zeros).
+    Host-side: nnz is a static shape, so filtering re-materializes."""
+    r = np.asarray(coo.rows)
+    c = np.asarray(coo.cols)
+    v = np.asarray(coo.vals)
+    keep = v != 0
+    return COO(jnp.asarray(r[keep]), jnp.asarray(c[keep]),
+               jnp.asarray(v[keep]), coo.shape)
+
+
+def max_duplicates(coo: COO) -> COO:
+    """Deduplicate (row, col) pairs summing values (ref:
+    sparse/op/reduce.hpp max_duplicates — the reference keeps a reduction
+    over duplicates; sum is its default for symmetrization)."""
+    n = max(coo.shape[1], 1)
+    key = np.asarray(coo.rows).astype(np.int64) * n + np.asarray(coo.cols)
+    uniq, inv = np.unique(key, return_inverse=True)
+    vals = np.zeros(len(uniq), dtype=np.asarray(coo.vals).dtype)
+    np.add.at(vals, inv, np.asarray(coo.vals))
+    rows = (uniq // n).astype(np.int32)
+    cols = (uniq % n).astype(np.int32)
+    return COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
+               coo.shape)
+
+
+def slice_csr(csr: CSR, start: int, stop: int) -> CSR:
+    """Row-range slice (ref: sparse/op/slice.hpp csr_row_slice_indptr /
+    csr_row_slice_populate). Host path — the slice changes nnz."""
+    indptr = np.asarray(csr.indptr)
+    lo, hi = int(indptr[start]), int(indptr[stop])
+    new_ptr = indptr[start : stop + 1] - lo
+    return CSR(jnp.asarray(new_ptr.astype(np.int32)),
+               csr.indices[lo:hi], csr.vals[lo:hi],
+               (stop - start, csr.shape[1]))
+
+
+def csr_row_op(csr: CSR, fn: Callable) -> CSR:
+    """Apply ``fn(row_id, vals_slice) -> vals_slice`` per row in one
+    vectorized pass (ref: sparse/op/row_op.hpp csr_row_op — the reference
+    launches a thread per row; here fn receives the per-nnz row ids)."""
+    rows = csr.row_ids()
+    return CSR(csr.indptr, csr.indices, fn(rows, csr.vals), csr.shape)

@@ -1,0 +1,11 @@
+"""Sparse graph solvers: MST and Lanczos eigensolver
+(ref: cpp/include/raft/sparse/solver)."""
+
+from raft_tpu.sparse.solver.mst import Graph_COO, mst
+from raft_tpu.sparse.solver.lanczos import (
+    lanczos_smallest_eigenpairs,
+    lanczos_largest_eigenpairs,
+)
+
+__all__ = ["Graph_COO", "mst", "lanczos_smallest_eigenpairs",
+           "lanczos_largest_eigenpairs"]

@@ -1,0 +1,111 @@
+"""COO / CSR sparse containers.
+
+Ref: cpp/include/raft/core/coo_matrix.hpp, core/csr_matrix.hpp,
+sparse/coo.hpp (``COO`` class), sparse/csr.hpp — owning/view COO & CSR
+structures over (rows, cols, vals) arrays with explicit shape.
+
+TPU-native: the containers are frozen pytree dataclasses over dense jax
+arrays, so they flow through jit/scan/shard_map like any other operand.
+``nnz`` is static (XLA static shapes); masked entries use row == -1
+sentinels where algorithms need padding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class COO:
+    """Coordinate-format sparse matrix (ref: sparse/coo.hpp COO)."""
+
+    rows: jax.Array   # (nnz,) int32
+    cols: jax.Array   # (nnz,) int32
+    vals: jax.Array   # (nnz,)
+    shape: Tuple[int, int]
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(int(s) for s in self.shape))
+
+    @property
+    def nnz(self) -> int:
+        return self.rows.shape[0]
+
+    def to_dense(self) -> jax.Array:
+        """Densify (ref: sparse/convert/dense.hpp csr_to_dense role)."""
+        m, n = self.shape
+        out = jnp.zeros((m, n), self.vals.dtype)
+        ok = self.rows >= 0
+        r = jnp.where(ok, self.rows, 0)
+        c = jnp.where(ok, self.cols, 0)
+        v = jnp.where(ok, self.vals, 0)
+        return out.at[r, c].add(v)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class CSR:
+    """Compressed-sparse-row matrix (ref: sparse/csr.hpp,
+    core/csr_matrix.hpp compressed_structure)."""
+
+    indptr: jax.Array  # (m+1,) int32
+    indices: jax.Array # (nnz,) int32
+    vals: jax.Array    # (nnz,)
+    shape: Tuple[int, int]
+
+    def __post_init__(self):
+        object.__setattr__(self, "shape", tuple(int(s) for s in self.shape))
+
+    @property
+    def nnz(self) -> int:
+        return self.indices.shape[0]
+
+    def row_ids(self) -> jax.Array:
+        """Expand indptr to per-nnz row ids (ref: csr_to_coo row expansion,
+        sparse/convert/coo.hpp)."""
+        m = self.shape[0]
+        counts = jnp.diff(self.indptr)
+        return jnp.repeat(jnp.arange(m, dtype=jnp.int32), counts,
+                          total_repeat_length=self.nnz)
+
+    def to_dense(self) -> jax.Array:
+        m, n = self.shape
+        return jnp.zeros((m, n), self.vals.dtype).at[
+            self.row_ids(), self.indices].add(self.vals)
+
+
+def coo_from_dense(a, keep_zeros: bool = False) -> COO:
+    """Host-side dense → COO (build path; nnz becomes a static shape)."""
+    a = np.asarray(a)
+    expects(a.ndim == 2, "dense input must be a matrix")
+    if keep_zeros:
+        r, c = np.indices(a.shape)
+        r, c = r.ravel(), c.ravel()
+    else:
+        r, c = np.nonzero(a)
+    return COO(jnp.asarray(r, jnp.int32), jnp.asarray(c, jnp.int32),
+               jnp.asarray(a[r, c]), a.shape)
+
+
+def csr_from_dense(a, keep_zeros: bool = False) -> CSR:
+    """Host-side dense → CSR."""
+    a = np.asarray(a)
+    expects(a.ndim == 2, "dense input must be a matrix")
+    if keep_zeros:
+        r, c = np.indices(a.shape)
+        r, c = r.ravel(), c.ravel()
+    else:
+        r, c = np.nonzero(a)
+    indptr = np.zeros(a.shape[0] + 1, np.int32)
+    np.add.at(indptr, r + 1, 1)
+    indptr = np.cumsum(indptr).astype(np.int32)
+    return CSR(jnp.asarray(indptr), jnp.asarray(c, jnp.int32),
+               jnp.asarray(a[r, c]), a.shape)

@@ -1,0 +1,16 @@
+"""Spectral graph partitioning and analysis
+(ref: cpp/include/raft/spectral, ~2,200 LoC)."""
+
+from raft_tpu.spectral.partition import (
+    EigenSolverConfig,
+    ClusterSolverConfig,
+    partition,
+    analyze_partition,
+    modularity_maximization,
+    analyze_modularity,
+)
+
+__all__ = [
+    "EigenSolverConfig", "ClusterSolverConfig", "partition",
+    "analyze_partition", "modularity_maximization", "analyze_modularity",
+]

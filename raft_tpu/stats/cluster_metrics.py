@@ -162,6 +162,14 @@ def silhouette_score(
     n = x.shape[0]
     if n_clusters is None:
         n_clusters = int(jnp.max(y)) + 1
+    import numpy as np
+
+    from raft_tpu.core.error import expects
+
+    # sklearn raises for a single populated cluster; a silent NaN would
+    # otherwise propagate into auto-k selection.
+    expects(len(np.unique(np.asarray(y))) >= 2,
+            "silhouette_score requires at least 2 populated clusters")
     onehot = jax.nn.one_hot(y, n_clusters, dtype=x.dtype)  # (n, k)
     counts = jnp.sum(onehot, axis=0)  # (k,)
 

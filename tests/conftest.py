@@ -5,7 +5,9 @@ the role raft-dask's LocalCUDACluster fixture plays in the reference
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the session environment may pin JAX_PLATFORMS to
+# a real accelerator; tests must run on the virtual CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -13,6 +15,11 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import jax  # noqa: E402
+
+# A site hook may have imported jax before this file with an accelerator
+# platform cached in config; override post-import (safe until the first
+# backend use, which conftest guarantees hasn't happened yet).
+jax.config.update("jax_platforms", "cpu")
 
 jax.config.update("jax_enable_x64", False)
 # Tests compare against float64 host references; force full-precision matmuls

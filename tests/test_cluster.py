@@ -1,0 +1,150 @@
+"""Cluster layer tests.
+
+Modeled on the reference's cluster tests (cpp/test/cluster/kmeans.cu,
+kmeans_balanced.cu): fit on well-separated gaussian blobs and check (a)
+inertia against sklearn-style expectations, (b) label agreement with the
+generating blob ids up to permutation, (c) balanced variant produces no
+empty clusters (the reference asserts cluster-size uniformity).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.cluster import (
+    KMeansBalancedParams,
+    KMeansParams,
+    InitMethod,
+    cluster_cost,
+    compute_new_centroids,
+    fit,
+    fit_predict,
+    init_plus_plus,
+    predict,
+    transform,
+)
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.random import make_blobs
+from raft_tpu.random.rng_state import RngState
+
+
+def _blobs(n=600, d=8, k=5, seed=7, std=0.4):
+    X, y = make_blobs(n, d, n_clusters=k, cluster_std=std, seed=seed, shuffle=True)
+    return np.asarray(X), np.asarray(y)
+
+
+def _label_accuracy(labels, truth, k):
+    """Best-match accuracy up to label permutation (greedy contingency)."""
+    labels = np.asarray(labels)
+    truth = np.asarray(truth)
+    cont = np.zeros((k, k))
+    for a, b in zip(labels, truth):
+        cont[int(a), int(b)] += 1
+    return cont.max(axis=1).sum() / len(labels)
+
+
+class TestKMeans:
+    def test_fit_recovers_blobs(self):
+        X, y = _blobs()
+        p = KMeansParams(n_clusters=5, max_iter=100, rng_state=RngState(seed=1))
+        centroids, labels, inertia, n_iter = fit_predict(p, X)
+        assert centroids.shape == (5, X.shape[1])
+        assert _label_accuracy(labels, y, 5) > 0.95
+        assert float(inertia) > 0
+        assert int(n_iter) >= 1
+
+    def test_random_init(self):
+        X, y = _blobs()
+        p = KMeansParams(n_clusters=5, init=InitMethod.Random, n_init=3,
+                         rng_state=RngState(seed=3))
+        centroids, inertia, _ = fit(p, X)
+        labels, _ = predict(p, centroids, X)
+        assert _label_accuracy(labels, y, 5) > 0.9
+
+    def test_inertia_close_to_sklearn_style_bound(self):
+        X, _ = _blobs(n=400, d=4, k=3, std=0.3)
+        p = KMeansParams(n_clusters=3, rng_state=RngState(seed=2))
+        _, inertia, _ = fit(p, X)
+        # For std=0.3 gaussians, per-sample squared distance ≈ d*std².
+        per_sample = float(inertia) / X.shape[0]
+        assert per_sample < 4 * X.shape[1] * 0.3 ** 2
+
+    def test_predict_matches_nearest(self):
+        X, _ = _blobs(n=200, d=4, k=4)
+        p = KMeansParams(n_clusters=4, rng_state=RngState(seed=5))
+        centroids, _, _ = fit(p, X)
+        labels, _ = predict(p, centroids, X)
+        d = np.linalg.norm(X[:, None, :] - np.asarray(centroids)[None], axis=2)
+        np.testing.assert_array_equal(np.asarray(labels), d.argmin(axis=1))
+
+    def test_transform_shape_and_values(self):
+        X, _ = _blobs(n=100, d=4, k=3)
+        p = KMeansParams(n_clusters=3, rng_state=RngState(seed=8))
+        centroids, _, _ = fit(p, X)
+        T = np.asarray(transform(p, centroids, X))
+        assert T.shape == (100, 3)
+        d = ((X[:, None, :] - np.asarray(centroids)[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(T, d, rtol=1e-3, atol=1e-3)
+
+    def test_cluster_cost(self):
+        X, _ = _blobs(n=100, d=4, k=3)
+        c = X[:3]
+        cost = float(cluster_cost(X, c))
+        d = ((X[:, None, :] - c[None]) ** 2).sum(-1).min(axis=1).sum()
+        np.testing.assert_allclose(cost, d, rtol=1e-3)
+
+    def test_compute_new_centroids(self):
+        X, _ = _blobs(n=100, d=4, k=3)
+        c = X[:3].copy()
+        new = np.asarray(compute_new_centroids(X, c))
+        labels = ((X[:, None, :] - c[None]) ** 2).sum(-1).argmin(axis=1)
+        for j in range(3):
+            np.testing.assert_allclose(
+                new[j], X[labels == j].mean(axis=0), rtol=1e-4, atol=1e-4
+            )
+
+    def test_init_plus_plus_spread(self):
+        X, _ = _blobs(n=300, d=4, k=5, std=0.2)
+        import jax
+
+        c = np.asarray(init_plus_plus(jax.random.key(0), np.asarray(X, np.float32), 5))
+        # Seeds should be spread: min pairwise distance well above cluster std.
+        d = np.linalg.norm(c[:, None] - c[None], axis=2)
+        np.fill_diagonal(d, np.inf)
+        assert d.min() > 1.0
+
+
+class TestKMeansBalanced:
+    def test_fit_predict_balance(self):
+        X, y = _blobs(n=1000, d=8, k=4, std=0.3)
+        p = KMeansBalancedParams(n_iters=20, rng_state=RngState(seed=1))
+        centroids, labels = kmeans_balanced.fit_predict(p, X, 4)
+        assert centroids.shape == (4, 8)
+        counts = np.bincount(np.asarray(labels), minlength=4)
+        assert counts.min() > 0
+        assert _label_accuracy(labels, y, 4) > 0.85
+
+    def test_no_empty_clusters_large_k(self):
+        X, _ = _blobs(n=2000, d=8, k=10, std=1.0)
+        p = KMeansBalancedParams(n_iters=10, rng_state=RngState(seed=2))
+        centroids, labels = kmeans_balanced.fit_predict(p, X, 32)
+        counts = np.bincount(np.asarray(labels), minlength=32)
+        # The balancing pass should keep every cluster populated.
+        assert (counts > 0).sum() >= 30
+
+    def test_hierarchical_path(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(4096, 16)).astype(np.float32)
+        p = KMeansBalancedParams(n_iters=6, rng_state=RngState(seed=3))
+        centroids = kmeans_balanced.fit(p, X, 300)
+        assert centroids.shape == (300, 16)
+        labels = kmeans_balanced.predict(p, centroids, X)
+        counts = np.bincount(np.asarray(labels), minlength=300)
+        assert (counts > 0).sum() > 250
+
+    def test_integer_input(self):
+        X, _ = _blobs(n=500, d=8, k=4)
+        Xu = np.clip((X * 10 + 128), 0, 255).astype(np.uint8)
+        p = KMeansBalancedParams(n_iters=10, rng_state=RngState(seed=4))
+        centroids, labels = kmeans_balanced.fit_predict(p, Xu, 4)
+        assert centroids.dtype == np.float32
+        assert len(np.unique(np.asarray(labels))) == 4

@@ -1,0 +1,142 @@
+"""Tests for spectral partitioning, single-linkage, label utilities and the
+LAP solver (ref: cpp/test/{cluster/linkage.cu, spectral, label, lap})."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.cluster import LinkageDistance, single_linkage
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.label import get_unique_labels, make_monotonic, merge_labels
+from raft_tpu.solver import LinearAssignmentProblem, lap
+from raft_tpu.sparse.types import csr_from_dense
+from raft_tpu.spectral import (
+    analyze_modularity,
+    analyze_partition,
+    modularity_maximization,
+    partition,
+)
+
+
+def _two_moons_blobs(rng, n=60):
+    a = rng.normal(size=(n // 2, 2)).astype(np.float32) * 0.3
+    b = rng.normal(size=(n // 2, 2)).astype(np.float32) * 0.3 + 5.0
+    return np.concatenate([a, b]), np.array([0] * (n // 2) + [1] * (n // 2))
+
+
+class TestSingleLinkage:
+    def test_two_blobs_pairwise(self, rng):
+        X, y = _two_moons_blobs(rng)
+        out = single_linkage(X, 2, dist_type=LinkageDistance.PAIRWISE)
+        labels = np.asarray(out.labels)
+        assert len(np.unique(labels)) == 2
+        # Perfect separation up to label swap.
+        same = (labels == y).mean()
+        assert same in (0.0, 1.0) or same > 0.95 or same < 0.05
+
+    def test_two_blobs_knn_graph(self, rng):
+        X, y = _two_moons_blobs(rng, n=100)
+        out = single_linkage(X, 2, dist_type=LinkageDistance.KNN_GRAPH, c=5)
+        labels = np.asarray(out.labels)
+        assert len(np.unique(labels)) == 2
+        same = (labels == y).mean()
+        assert same > 0.95 or same < 0.05
+
+    def test_matches_scipy_dendrogram_heights(self, rng):
+        try:
+            from scipy.cluster.hierarchy import linkage
+        except ImportError:
+            pytest.skip("scipy missing")
+        X = rng.normal(size=(25, 3)).astype(np.float32)
+        out = single_linkage(X, 1, dist_type=LinkageDistance.PAIRWISE)
+        ref = linkage(X, method="single", metric="euclidean")
+        np.testing.assert_allclose(
+            np.sort(out.distances), np.sort(ref[:, 2]), rtol=1e-4)
+
+    def test_n_clusters_cut(self, rng):
+        X = rng.normal(size=(30, 4)).astype(np.float32)
+        out = single_linkage(X, 5, dist_type=LinkageDistance.PAIRWISE)
+        assert len(np.unique(np.asarray(out.labels))) == 5
+
+
+class TestSpectral:
+    def _two_cliques(self, n=10, bridge=1):
+        # Two n-cliques joined by a weak bridge.
+        N = 2 * n
+        a = np.zeros((N, N), np.float32)
+        a[:n, :n] = 1.0
+        a[n:, n:] = 1.0
+        np.fill_diagonal(a, 0.0)
+        a[0, n] = a[n, 0] = 0.1
+        return a
+
+    def test_partition_two_cliques(self):
+        a = self._two_cliques()
+        labels, evals, evecs = partition(csr_from_dense(a), 2)
+        lab = np.asarray(labels)
+        assert (lab[:10] == lab[0]).all()
+        assert (lab[10:] == lab[10]).all()
+        assert lab[0] != lab[10]
+
+    def test_analyze_partition(self):
+        a = self._two_cliques()
+        labels = np.array([0] * 10 + [1] * 10)
+        cut, cost = analyze_partition(csr_from_dense(a), labels, 2)
+        np.testing.assert_allclose(cut, 0.1, atol=1e-5)
+
+    def test_modularity_maximization(self):
+        a = self._two_cliques()
+        labels, w, U = modularity_maximization(csr_from_dense(a), 2)
+        lab = np.asarray(labels)
+        assert (lab[:10] == lab[0]).all() and (lab[10:] == lab[10]).all()
+        q = analyze_modularity(csr_from_dense(a), lab)
+        assert q > 0.3
+
+
+class TestLabel:
+    def test_unique_labels(self):
+        u = np.asarray(get_unique_labels(np.array([5, 3, 5, 9])))
+        np.testing.assert_array_equal(u, [3, 5, 9])
+
+    def test_make_monotonic(self):
+        mapped, classes = make_monotonic(np.array([10, 20, 10, 30]))
+        np.testing.assert_array_equal(np.asarray(mapped), [0, 1, 0, 2])
+        np.testing.assert_array_equal(np.asarray(classes), [10, 20, 30])
+
+    def test_merge_labels(self):
+        a = jnp.asarray([0, 0, 2, 2, 4], jnp.int32)
+        b = jnp.asarray([0, 2, 2, 4, 4], jnp.int32)
+        mask = jnp.asarray([True, True, True, True, True])
+        merged = np.asarray(merge_labels(a, b, mask))
+        # All linked through shared core points → one class, min label 0.
+        np.testing.assert_array_equal(merged, [0, 0, 0, 0, 0])
+
+
+class TestLap:
+    def test_identity_cost(self):
+        c = np.eye(4, dtype=np.float32) * -10 + 1
+        assign, total = lap(c)
+        np.testing.assert_array_equal(np.sort(np.asarray(assign)), np.arange(4))
+        np.testing.assert_allclose(float(total), -36.0, atol=1e-3)
+
+    def test_matches_scipy(self, rng):
+        try:
+            from scipy.optimize import linear_sum_assignment
+        except ImportError:
+            pytest.skip("scipy missing")
+        c = rng.random((12, 12)).astype(np.float32)
+        assign, total = lap(c)
+        r, col = linear_sum_assignment(c)
+        expect = c[r, col].sum()
+        assert np.asarray(assign).min() >= 0
+        assert len(np.unique(np.asarray(assign))) == 12
+        np.testing.assert_allclose(float(total), expect, rtol=2e-2)
+
+    def test_batched_class(self, rng):
+        costs = rng.random((3, 8, 8)).astype(np.float32)
+        p = LinearAssignmentProblem(8, batchsize=3)
+        p.solve(costs)
+        for b in range(3):
+            a = np.asarray(p.getAssignmentVector(b))
+            assert len(np.unique(a)) == 8

@@ -1,0 +1,133 @@
+"""IVF-PQ tests — recall-threshold scheme copied from the reference
+(cpp/test/neighbors/ann_ivf_pq.cuh:387-470: recall vs exact ground truth
+with per-config min_recall; python/pylibraft test_ivf_pq.py:191 asserts
+recall > 0.7 vs sklearn ground truth)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_pq, refine
+
+
+def _naive_knn(queries, db, k):
+    d = ((queries[:, None, :] - db[None]) ** 2).sum(-1)
+    idx = np.argsort(d, axis=1)[:, :k]
+    return np.take_along_axis(d, idx, axis=1), idx
+
+
+def _recall(found, truth):
+    n, k = truth.shape
+    hits = sum(len(np.intersect1d(found[i], truth[i])) for i in range(n))
+    return hits / (n * k)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(6000, 32)).astype(np.float32)
+    q = rng.normal(size=(60, 32)).astype(np.float32)
+    _, truth = _naive_knn(q, db, 10)
+    return db, q, truth
+
+
+class TestIvfPq:
+    def test_recall_per_subspace(self, dataset):
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, pq_bits=8,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        assert index.size == len(db)
+        assert index.pq_centers.shape == (16, 256, 2)
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, q, 10)
+        # All lists probed; PQ quantization alone should keep recall high
+        # (ref threshold family: min_recall = 0.86 for comparable configs).
+        assert _recall(np.asarray(i), truth) > 0.7
+
+    def test_recall_per_cluster(self, dataset):
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(
+            n_lists=32, pq_dim=16, pq_bits=8, kmeans_n_iters=10,
+            codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER)
+        index = ivf_pq.build(params, db)
+        assert index.pq_centers.shape == (32, 256, 2)
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.6
+
+    def test_recall_partial_probes(self, dataset):
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8), index, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.4
+
+    def test_refine_recovers_recall(self, dataset):
+        """ANN candidates + exact refine — the reference's standard recipe
+        (refine.cuh; test_ivf_pq.py refine path)."""
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=32), index, q, 40)
+        d, i = refine(db, q, np.asarray(cand), 10)
+        r_refined = _recall(np.asarray(i), truth)
+        assert r_refined > 0.9
+
+    def test_low_pq_bits(self, dataset):
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, pq_bits=4,
+                                    kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        assert index.pq_centers.shape[-2] == 16
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 10)
+        # 4-bit codebooks lose accuracy; formula-style lower bound
+        # (ref: fp8/low-bit threshold formula, ann_ivf_pq.cuh:257-265).
+        assert _recall(np.asarray(i), truth) > 0.3
+
+    def test_bf16_lut(self, dataset):
+        import jax.numpy as jnp
+
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        d, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, lut_dtype=jnp.bfloat16),
+            index, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.6
+
+    def test_extend(self, dataset):
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10,
+                                    add_data_on_build=False)
+        index = ivf_pq.build(params, db)
+        assert index.size == 0
+        index = ivf_pq.extend(index, db[:3000])
+        index = ivf_pq.extend(index, db[3000:],
+                              np.arange(3000, len(db), dtype=np.int32))
+        assert index.size == len(db)
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.7
+
+    def test_save_load_roundtrip(self, dataset, tmp_path):
+        db, q, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, db[:2000])
+        f = str(tmp_path / "ivf_pq_index.npz")
+        ivf_pq.save(f, index)
+        loaded = ivf_pq.load(f)
+        d1, i1 = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 5)
+        d2, i2 = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), loaded, q, 5)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), rtol=1e-5)
+
+    def test_rotation_matrix_orthonormal(self):
+        import jax
+
+        rot = ivf_pq.make_rotation_matrix(jax.random.key(0), 24, 24, True)
+        np.testing.assert_allclose(
+            np.asarray(rot @ rot.T), np.eye(24), atol=1e-4)
+
+    def test_auto_pq_dim(self, dataset):
+        db, _, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, db[:2000])
+        assert index.pq_dim == 16  # dim 32 → dim/2

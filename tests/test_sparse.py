@@ -1,0 +1,208 @@
+"""Sparse layer tests — comparison against scipy.sparse / host references,
+the reference's test style (cpp/test/sparse/*)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse import COO, CSR, convert, distance, linalg, neighbors, op
+from raft_tpu.sparse.types import coo_from_dense, csr_from_dense
+from raft_tpu.sparse.solver import (
+    lanczos_smallest_eigenpairs,
+    mst,
+)
+
+
+def _rand_sparse(rng, m=30, n=20, density=0.2):
+    a = rng.random((m, n)).astype(np.float32)
+    a[a > density] = 0.0
+    return a
+
+
+class TestFormats:
+    def test_coo_dense_roundtrip(self, rng):
+        a = _rand_sparse(rng)
+        coo = coo_from_dense(a)
+        np.testing.assert_allclose(np.asarray(coo.to_dense()), a)
+
+    def test_csr_dense_roundtrip(self, rng):
+        a = _rand_sparse(rng)
+        csr = csr_from_dense(a)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), a)
+
+    def test_coo_csr_conversion(self, rng):
+        a = _rand_sparse(rng)
+        coo = coo_from_dense(a)
+        csr = convert.coo_to_csr(coo)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), a)
+        back = convert.csr_to_coo(csr)
+        np.testing.assert_allclose(np.asarray(back.to_dense()), a)
+
+    def test_coo_sort_and_dedupe(self):
+        coo = COO(jnp.asarray([1, 0, 1], jnp.int32),
+                  jnp.asarray([0, 1, 0], jnp.int32),
+                  jnp.asarray([2.0, 3.0, 4.0], jnp.float32), (2, 2))
+        d = op.max_duplicates(coo)
+        assert d.nnz == 2
+        dense = np.asarray(d.to_dense())
+        np.testing.assert_allclose(dense, [[0, 3], [6, 0]])
+
+    def test_remove_zeros(self):
+        coo = COO(jnp.asarray([0, 1], jnp.int32), jnp.asarray([0, 1], jnp.int32),
+                  jnp.asarray([0.0, 5.0], jnp.float32), (2, 2))
+        f = op.remove_zeros(coo)
+        assert f.nnz == 1
+
+    def test_slice_csr(self, rng):
+        a = _rand_sparse(rng)
+        csr = csr_from_dense(a)
+        s = op.slice_csr(csr, 5, 15)
+        np.testing.assert_allclose(np.asarray(s.to_dense()), a[5:15])
+
+
+class TestLinalg:
+    def test_spmv(self, rng):
+        a = _rand_sparse(rng)
+        x = rng.random(a.shape[1]).astype(np.float32)
+        y = linalg.spmv(csr_from_dense(a), jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), a @ x, rtol=1e-5)
+
+    def test_spmm(self, rng):
+        a = _rand_sparse(rng)
+        b = rng.random((a.shape[1], 7)).astype(np.float32)
+        y = linalg.spmm(csr_from_dense(a), jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(y), a @ b, rtol=1e-5)
+
+    def test_add(self, rng):
+        a = _rand_sparse(rng)
+        b = _rand_sparse(rng)
+        c = linalg.add(csr_from_dense(a), csr_from_dense(b))
+        np.testing.assert_allclose(np.asarray(c.to_dense()), a + b, rtol=1e-6)
+
+    def test_transpose(self, rng):
+        a = _rand_sparse(rng)
+        t = linalg.transpose(csr_from_dense(a))
+        np.testing.assert_allclose(np.asarray(t.to_dense()), a.T)
+
+    def test_row_normalize_l1(self, rng):
+        a = np.abs(_rand_sparse(rng)) + 0.0
+        nrm = linalg.row_normalize_l1(csr_from_dense(a))
+        d = np.asarray(nrm.to_dense())
+        sums = d.sum(axis=1)
+        nz = a.sum(axis=1) > 0
+        np.testing.assert_allclose(sums[nz], 1.0, rtol=1e-5)
+
+    def test_degree(self, rng):
+        a = _rand_sparse(rng)
+        coo = coo_from_dense(a)
+        deg = np.asarray(linalg.degree(coo))
+        np.testing.assert_array_equal(deg, (a != 0).sum(axis=1))
+
+    def test_symmetrize(self, rng):
+        a = _rand_sparse(rng, m=20, n=20)
+        s = linalg.symmetrize(coo_from_dense(a))
+        d = np.asarray(s.to_dense())
+        np.testing.assert_allclose(d, (a + a.T) / 2, rtol=1e-6, atol=1e-7)
+
+    def test_laplacian_rowsums_zero(self, rng):
+        a = _rand_sparse(rng, m=15, n=15)
+        a = (a + a.T) / 2
+        np.fill_diagonal(a, 0)
+        L = linalg.laplacian(csr_from_dense(a))
+        d = np.asarray(L.to_dense())
+        np.testing.assert_allclose(d.sum(axis=1), 0.0, atol=1e-5)
+
+
+class TestDistanceKnn:
+    def test_sparse_pairwise_l2_matches_dense(self, rng):
+        a = _rand_sparse(rng, m=25, n=12)
+        b = _rand_sparse(rng, m=18, n=12)
+        d = distance.pairwise_distance(csr_from_dense(a), csr_from_dense(b),
+                                       metric="sqeuclidean")
+        expect = ((a[:, None, :] - b[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(np.asarray(d), expect, rtol=1e-4, atol=1e-4)
+
+    def test_sparse_knn(self, rng):
+        a = _rand_sparse(rng, m=50, n=10)
+        b = _rand_sparse(rng, m=30, n=10)
+        dist, idx = neighbors.brute_force_knn(
+            csr_from_dense(a), csr_from_dense(b), 5)
+        expect = ((b[:, None, :] - a[None]) ** 2).sum(-1)
+        truth = np.argsort(expect, axis=1)[:, :5]
+        found = np.asarray(idx)
+        hits = sum(len(np.intersect1d(found[i], truth[i])) for i in range(30))
+        assert hits / truth.size > 0.95
+
+    def test_knn_graph(self, rng):
+        X = rng.normal(size=(40, 4)).astype(np.float32)
+        g = neighbors.knn_graph(X, 3)
+        assert g.shape == (40, 40)
+        r = np.asarray(g.rows)
+        assert (np.bincount(r, minlength=40) >= 3).all()
+
+    def test_connect_components(self, rng):
+        X = np.concatenate([
+            rng.normal(size=(10, 2)).astype(np.float32),
+            rng.normal(size=(10, 2)).astype(np.float32) + 20.0,
+        ])
+        labels = np.array([0] * 10 + [1] * 10)
+        edges = neighbors.connect_components(X, labels)
+        assert edges.nnz >= 1
+        r = np.asarray(edges.rows)
+        c = np.asarray(edges.cols)
+        assert ((labels[r] != labels[c])).all()
+
+
+class TestSolvers:
+    def test_mst_simple_graph(self):
+        # Path graph with a heavy extra edge: MST must drop it.
+        rows = np.array([0, 1, 2, 0, 1, 2, 3, 0], np.int32)
+        cols = np.array([1, 2, 3, 2, 0, 1, 2, 3], np.int32)
+        w = np.array([1.0, 2.0, 3.0, 10.0, 1.0, 2.0, 3.0, 10.0], np.float32)
+        g = mst(rows, cols, w, 4)
+        assert g.n_edges == 3
+        assert float(np.asarray(g.weights).sum()) == pytest.approx(6.0)
+
+    def test_mst_matches_scipy(self, rng):
+        try:
+            from scipy.sparse import csr_matrix
+            from scipy.sparse.csgraph import minimum_spanning_tree
+        except ImportError:
+            pytest.skip("scipy not available")
+        n = 30
+        X = rng.normal(size=(n, 3))
+        d = ((X[:, None] - X[None]) ** 2).sum(-1)
+        # complete graph, symmetric edge list
+        r, c = np.nonzero(np.ones((n, n)) - np.eye(n))
+        w = d[r, c].astype(np.float32)
+        g = mst(r.astype(np.int32), c.astype(np.int32), w, n)
+        expect = minimum_spanning_tree(csr_matrix(d)).sum()
+        assert g.n_edges == n - 1
+        np.testing.assert_allclose(float(np.asarray(g.weights).sum()),
+                                   float(expect), rtol=1e-4)
+
+    def test_mst_forest_disconnected(self):
+        rows = np.array([0, 2], np.int32)
+        cols = np.array([1, 3], np.int32)
+        w = np.array([1.0, 2.0], np.float32)
+        g = mst(rows, cols, w, 4)
+        assert g.n_edges == 2
+
+    def test_lanczos_smallest(self, rng):
+        # Symmetric PSD matrix with known spectrum: graph Laplacian of a
+        # path has smallest eigenvalue 0.
+        n = 40
+        a = np.zeros((n, n), np.float32)
+        for i in range(n - 1):
+            a[i, i + 1] = a[i + 1, i] = 1.0
+        L = linalg.laplacian(csr_from_dense(a))
+        w, U = lanczos_smallest_eigenpairs(L, 3, seed=1)
+        w = np.asarray(w)
+        dense = np.asarray(L.to_dense())
+        expect = np.sort(np.linalg.eigvalsh(dense))[:3]
+        np.testing.assert_allclose(w, expect, atol=1e-2)
+        # Residual check ||L u - λ u||
+        for j in range(3):
+            u = np.asarray(U)[:, j]
+            assert np.linalg.norm(dense @ u - w[j] * u) < 1e-2
