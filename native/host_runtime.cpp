@@ -1,0 +1,263 @@
+// TPU-RAFT native host runtime.
+//
+// The reference implements its host-side runtime in C++ (the raft_runtime
+// layer, cpp/src; host refinement detail/refine.cuh:162; dataset IO in
+// benches). This library is the TPU build's host-native analog: the XLA
+// device does the math, this code does the host work around it — dataset
+// IO (fvecs/bvecs/ivecs), threaded exact re-ranking, k-way merge of sorted
+// kNN parts, and a heap-based host select_k. Exposed through a C ABI and
+// loaded from Python via ctypes (no pybind11 in the image).
+//
+// Build: make -C native   (g++ -O3 -shared -pthread)
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <limits>
+#include <queue>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Simple blocked parallel-for over a hardware-sized thread pool. Mirrors the
+// bounded-OpenMP policy of the reference (docs/source/developer_guide.md:68).
+template <typename F>
+void parallel_for(int64_t n, F&& fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t n_threads = std::max<int64_t>(1, std::min<int64_t>(hw ? hw : 4, n));
+  if (n_threads == 1) {
+    for (int64_t i = 0; i < n; ++i) fn(i);
+    return;
+  }
+  std::atomic<int64_t> next{0};
+  std::vector<std::thread> pool;
+  pool.reserve(n_threads);
+  for (int64_t t = 0; t < n_threads; ++t) {
+    pool.emplace_back([&] {
+      for (;;) {
+        int64_t i = next.fetch_add(1);
+        if (i >= n) return;
+        fn(i);
+      }
+    });
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Dataset IO: the *.vecs family used by SIFT/GIST ANN datasets.
+// Layout per row: int32 dim, then dim elements (float32 / uint8 / int32).
+// Returns 0 on success. First call with data=nullptr to query rows/cols.
+// ---------------------------------------------------------------------------
+
+static int read_vecs_impl(const char* path, int elt_size, int64_t* rows,
+                          int64_t* cols, void* data) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t dim = 0;
+  if (std::fread(&dim, sizeof(int32_t), 1, f) != 1 || dim <= 0) {
+    std::fclose(f);
+    return -2;
+  }
+  std::fseek(f, 0, SEEK_END);
+  int64_t fsize = std::ftell(f);
+  int64_t row_bytes = sizeof(int32_t) + (int64_t)dim * elt_size;
+  if (fsize % row_bytes != 0) {
+    std::fclose(f);
+    return -3;
+  }
+  int64_t n = fsize / row_bytes;
+  *rows = n;
+  *cols = dim;
+  if (data == nullptr) {
+    std::fclose(f);
+    return 0;
+  }
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<char> buf(row_bytes);
+  char* out = static_cast<char*>(data);
+  for (int64_t r = 0; r < n; ++r) {
+    if (std::fread(buf.data(), 1, row_bytes, f) != (size_t)row_bytes) {
+      std::fclose(f);
+      return -4;
+    }
+    std::memcpy(out + r * (int64_t)dim * elt_size, buf.data() + sizeof(int32_t),
+                (size_t)dim * elt_size);
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int raft_read_fvecs(const char* path, int64_t* rows, int64_t* cols,
+                    float* data) {
+  return read_vecs_impl(path, 4, rows, cols, data);
+}
+
+int raft_read_bvecs(const char* path, int64_t* rows, int64_t* cols,
+                    uint8_t* data) {
+  return read_vecs_impl(path, 1, rows, cols, data);
+}
+
+int raft_read_ivecs(const char* path, int64_t* rows, int64_t* cols,
+                    int32_t* data) {
+  return read_vecs_impl(path, 4, rows, cols, data);
+}
+
+int raft_write_fvecs(const char* path, int64_t rows, int64_t cols,
+                     const float* data) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int32_t dim = (int32_t)cols;
+  for (int64_t r = 0; r < rows; ++r) {
+    if (std::fwrite(&dim, sizeof(int32_t), 1, f) != 1 ||
+        std::fwrite(data + r * cols, sizeof(float), cols, f) != (size_t)cols) {
+      std::fclose(f);
+      return -2;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Host refine: exact re-rank of candidate lists (ref detail/refine.cuh:162,
+// the host OpenMP path). metric: 0 = sqeuclidean, 1 = inner product.
+// candidates: (n_queries, n_cand) int64 (-1 = padding).
+// Writes (n_queries, k) distances + indices.
+// ---------------------------------------------------------------------------
+
+int raft_refine_host(const float* dataset, int64_t n_rows, int64_t dim,
+                     const float* queries, int64_t n_queries,
+                     const int64_t* candidates, int64_t n_cand, int64_t k,
+                     int metric, float* out_dist, int64_t* out_idx) {
+  if (k > n_cand) return -1;
+  parallel_for(n_queries, [&](int64_t q) {
+    const float* qv = queries + q * dim;
+    std::vector<std::pair<float, int64_t>> scored;
+    scored.reserve(n_cand);
+    for (int64_t c = 0; c < n_cand; ++c) {
+      int64_t id = candidates[q * n_cand + c];
+      if (id < 0 || id >= n_rows) continue;
+      const float* dv = dataset + id * dim;
+      float acc = 0.f;
+      if (metric == 0) {
+        for (int64_t j = 0; j < dim; ++j) {
+          float diff = qv[j] - dv[j];
+          acc += diff * diff;
+        }
+      } else {
+        for (int64_t j = 0; j < dim; ++j) acc += qv[j] * dv[j];
+        acc = -acc;  // max-IP as min-(-IP)
+      }
+      scored.emplace_back(acc, id);
+    }
+    int64_t kk = std::min<int64_t>(k, (int64_t)scored.size());
+    std::partial_sort(scored.begin(), scored.begin() + kk, scored.end());
+    for (int64_t j = 0; j < k; ++j) {
+      if (j < kk) {
+        out_dist[q * k + j] = (metric == 0) ? scored[j].first : -scored[j].first;
+        out_idx[q * k + j] = scored[j].second;
+      } else {
+        out_dist[q * k + j] = (metric == 0)
+                                  ? std::numeric_limits<float>::infinity()
+                                  : -std::numeric_limits<float>::infinity();
+        out_idx[q * k + j] = -1;
+      }
+    }
+  });
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// knn_merge_parts (host): merge P per-part sorted top-k lists into a global
+// top-k (ref neighbors/brute_force.cuh:80 knn_merge_parts; detail
+// knn_merge_parts.cuh warp-select merge). parts laid out
+// (n_parts, n_queries, k); translations shift part-local ids.
+// ---------------------------------------------------------------------------
+
+int raft_knn_merge_parts(const float* dists, const int64_t* ids,
+                         int64_t n_parts, int64_t n_queries, int64_t k,
+                         int select_min, const int64_t* translations,
+                         float* out_dist, int64_t* out_idx) {
+  if (n_parts <= 0 || k <= 0) return -1;
+  parallel_for(n_queries, [&](int64_t q) {
+    // k-way merge via a heap of (value, part, pos)
+    struct Node {
+      float v;
+      int64_t part, pos;
+    };
+    auto better = [&](const Node& a, const Node& b) {
+      return select_min ? a.v > b.v : a.v < b.v;  // heap comparator (worst on top)
+    };
+    std::vector<Node> heap;
+    heap.reserve(n_parts);
+    for (int64_t p = 0; p < n_parts; ++p) {
+      heap.push_back({dists[(p * n_queries + q) * k], p, 0});
+    }
+    std::make_heap(heap.begin(), heap.end(), better);
+    for (int64_t j = 0; j < k; ++j) {
+      std::pop_heap(heap.begin(), heap.end(), better);
+      Node top = heap.back();
+      heap.pop_back();
+      out_dist[q * k + j] = top.v;
+      int64_t raw = ids[(top.part * n_queries + q) * k + top.pos];
+      out_idx[q * k + j] =
+          raw < 0 ? raw : raw + (translations ? translations[top.part] : 0);
+      if (top.pos + 1 < k) {
+        heap.push_back({dists[(top.part * n_queries + q) * k + top.pos + 1],
+                        top.part, top.pos + 1});
+        std::push_heap(heap.begin(), heap.end(), better);
+      }
+    }
+  });
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Host select_k: batched top-k over a dense (batch, len) matrix (ref
+// matrix/detail/select_k.cuh dispatch — radix vs warpsort; host analog is a
+// bounded heap per row, threaded over the batch).
+// ---------------------------------------------------------------------------
+
+int raft_select_k_host(const float* in, int64_t batch, int64_t len, int64_t k,
+                       int select_min, float* out_val, int64_t* out_idx) {
+  if (k > len) return -1;
+  parallel_for(batch, [&](int64_t b) {
+    const float* row = in + b * len;
+    using P = std::pair<float, int64_t>;
+    auto worse = [&](const P& a, const P& x) {
+      return select_min ? a.first < x.first : a.first > x.first;
+    };
+    std::vector<P> heap;
+    heap.reserve(k);
+    for (int64_t i = 0; i < len; ++i) {
+      if ((int64_t)heap.size() < k) {
+        heap.emplace_back(row[i], i);
+        std::push_heap(heap.begin(), heap.end(), worse);
+      } else if (select_min ? row[i] < heap.front().first
+                            : row[i] > heap.front().first) {
+        std::pop_heap(heap.begin(), heap.end(), worse);
+        heap.back() = {row[i], i};
+        std::push_heap(heap.begin(), heap.end(), worse);
+      }
+    }
+    std::sort_heap(heap.begin(), heap.end(), worse);
+    for (int64_t j = 0; j < k; ++j) {
+      out_val[b * k + j] = heap[j].first;
+      out_idx[b * k + j] = heap[j].second;
+    }
+  });
+  return 0;
+}
+
+int raft_native_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,16 @@
+"""pylibraft-compatible API surface over the TPU-native ``raft_tpu`` core.
+
+Mirrors the module layout and entry points of the reference's
+``python/pylibraft`` package (Cython over ``raft::runtime``), so code written
+against pylibraft runs on TPU unchanged modulo the array types: inputs are
+anything NumPy/JAX can ingest (``__array__``, ``__cuda_array_interface__`` is
+replaced by jax Arrays living in HBM), outputs are ``device_ndarray`` wrappers
+over jax Arrays.
+
+Ref layout: python/pylibraft/pylibraft/{common,distance,neighbors,cluster,
+random}.
+"""
+
+__version__ = "23.04.00+tpu"
+
+from pylibraft import cluster, common, distance, neighbors, random  # noqa: E402,F401

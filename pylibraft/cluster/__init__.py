@@ -1,0 +1,5 @@
+"""pylibraft.cluster — k-means (ref python/pylibraft/pylibraft/cluster)."""
+
+from pylibraft.cluster import kmeans
+
+__all__ = ["kmeans"]

@@ -1,0 +1,198 @@
+"""k-means clustering, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/cluster/kmeans.pyx — compute_new_centroids
+(:54), init_plus_plus (:205), cluster_cost (:289), InitMethod (:375),
+KMeansParams (:382), fit (:496). Backed by raft_tpu.cluster.kmeans (fused
+L2-argmin EM loop on MXU).
+"""
+
+from __future__ import annotations
+
+from enum import IntEnum
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.cluster import kmeans as _impl
+from raft_tpu.cluster.kmeans_types import InitMethod as _InitMethod
+from raft_tpu.cluster.kmeans_types import KMeansParams as _Params
+from raft_tpu.distance.distance_types import DISTANCE_TYPES
+from raft_tpu.random.rng_state import RngState
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+
+
+class InitMethod(IntEnum):
+    """Ref cluster/kmeans.pyx:375."""
+
+    KMeansPlusPlus = 0
+    Random = 1
+    Array = 2
+
+
+class KMeansParams:
+    """Ref cluster/kmeans.pyx:382-492: optional-kwarg construction over the
+    C++ defaults; same field names."""
+
+    def __init__(self,
+                 n_clusters: Optional[int] = None,
+                 max_iter: Optional[int] = None,
+                 tol: Optional[float] = None,
+                 verbosity: Optional[int] = None,
+                 seed: Optional[int] = None,
+                 metric: Optional[str] = None,
+                 init: Optional[InitMethod] = None,
+                 n_init: Optional[int] = None,
+                 oversampling_factor: Optional[float] = None,
+                 batch_samples: Optional[int] = None,
+                 batch_centroids: Optional[int] = None,
+                 inertia_check: Optional[bool] = None):
+        kwargs = {}
+        if n_clusters is not None:
+            kwargs["n_clusters"] = n_clusters
+        if max_iter is not None:
+            kwargs["max_iter"] = max_iter
+        if tol is not None:
+            kwargs["tol"] = tol
+        if verbosity is not None:
+            kwargs["verbosity"] = verbosity
+        if seed is not None:
+            kwargs["rng_state"] = RngState(seed=seed)
+        if metric is not None:
+            if metric not in DISTANCE_TYPES:
+                raise ValueError(
+                    f"Unknown metric '{metric}'. Valid values are: "
+                    f"{list(DISTANCE_TYPES)}")
+            kwargs["metric"] = DISTANCE_TYPES[metric]
+        if init is not None:
+            kwargs["init"] = _InitMethod(int(init))
+        if n_init is not None:
+            kwargs["n_init"] = n_init
+        if oversampling_factor is not None:
+            kwargs["oversampling_factor"] = oversampling_factor
+        if batch_samples is not None:
+            kwargs["batch_samples"] = batch_samples
+        if batch_centroids is not None:
+            kwargs["batch_centroids"] = batch_centroids
+        if inertia_check is not None:
+            kwargs["inertia_check"] = inertia_check
+        self.params = _Params(**kwargs)
+
+    @property
+    def n_clusters(self):
+        return self.params.n_clusters
+
+    @property
+    def max_iter(self):
+        return self.params.max_iter
+
+    @property
+    def tol(self):
+        return self.params.tol
+
+    @property
+    def verbosity(self):
+        return self.params.verbosity
+
+    @property
+    def seed(self):
+        return self.params.rng_state.seed
+
+    @property
+    def init(self):
+        return InitMethod(self.params.init.value)
+
+    @property
+    def oversampling_factor(self):
+        return self.params.oversampling_factor
+
+    @property
+    def batch_samples(self):
+        return self.params.batch_samples
+
+    @property
+    def batch_centroids(self):
+        return self.params.batch_centroids
+
+    @property
+    def inertia_check(self):
+        return self.params.inertia_check
+
+
+@auto_sync_handle
+@auto_convert_output
+def compute_new_centroids(X, centroids, labels, new_centroids,
+                          sample_weights=None, weight_per_cluster=None,
+                          handle=None):
+    """Ref cluster/kmeans.pyx:54 — one centroid-update step; writes
+    ``new_centroids`` in place when it is a numpy array and returns it."""
+    x = cai_wrapper(X)
+    c = cai_wrapper(centroids)
+    lab = cai_wrapper(labels)
+    w = None if sample_weights is None else cai_wrapper(sample_weights).array
+    new = _impl.compute_new_centroids(x.array, c.array, lab.array, w)
+    if weight_per_cluster is not None:
+        # aggregated per-cluster weight, filled like the reference
+        # (kmeans.pyx:155 passes the buffer through to update_centroids)
+        wvec = (jnp.ones((x.shape[0],), jnp.float32) if w is None
+                else jnp.ravel(w).astype(jnp.float32))
+        agg = jnp.zeros((c.shape[0],), jnp.float32).at[
+            jnp.ravel(lab.array).astype(jnp.int32)].add(wvec)
+        if isinstance(weight_per_cluster, np.ndarray):
+            np.copyto(weight_per_cluster, np.asarray(agg).reshape(
+                weight_per_cluster.shape))
+        elif hasattr(weight_per_cluster, "_array"):
+            weight_per_cluster._array = agg
+    if isinstance(new_centroids, np.ndarray):
+        np.copyto(new_centroids, np.asarray(new))
+        return new_centroids
+    if hasattr(new_centroids, "_array"):
+        new_centroids._array = new
+        return new_centroids
+    return new
+
+
+@auto_sync_handle
+@auto_convert_output
+def init_plus_plus(X, n_clusters=None, seed=None, handle=None,
+                   centroids=None):
+    """Ref cluster/kmeans.pyx:205 — k-means++ seeding."""
+    if (n_clusters is not None and centroids is not None
+            and n_clusters != np.asarray(centroids).shape[0]):
+        raise RuntimeError(
+            "Parameters 'n_clusters' and 'centroids' are exclusive")
+    x = cai_wrapper(X)
+    if n_clusters is None:
+        if centroids is None:
+            raise RuntimeError("either n_clusters or centroids is required")
+        n_clusters = np.asarray(centroids).shape[0]
+    import jax
+
+    key = jax.random.key(0 if seed is None else int(seed))
+    out = _impl.init_plus_plus(key, x.array, int(n_clusters))
+    if centroids is not None and isinstance(centroids, np.ndarray):
+        np.copyto(centroids, np.asarray(out))
+        return centroids
+    return out
+
+
+@auto_sync_handle
+def cluster_cost(X, centroids, handle=None):
+    """Ref cluster/kmeans.pyx:289 — inertia of X against centroids."""
+    x = cai_wrapper(X)
+    c = cai_wrapper(centroids)
+    return float(_impl.cluster_cost(x.array, c.array))
+
+
+@auto_sync_handle
+@auto_convert_output
+def fit(params: KMeansParams, X, centroids=None, sample_weights=None,
+        handle=None):
+    """Ref cluster/kmeans.pyx:496 — returns (centroids, inertia, n_iter)."""
+    x = cai_wrapper(X)
+    c0 = None if centroids is None else cai_wrapper(centroids).array
+    cen, inertia, n_iter = _impl.fit(
+        params.params, x.array, sample_weight=sample_weights,
+        centroids_init=c0)
+    return cen, float(inertia), int(n_iter)

@@ -1,0 +1,58 @@
+"""Array-interface ingestion wrappers.
+
+Ref: python/pylibraft/pylibraft/common/{ai_wrapper.py,cai_wrapper.py:21} —
+the reference wraps ``__array_interface__`` / ``__cuda_array_interface__``
+objects for zero-copy pointer access. The TPU analog normalizes any
+array-like (numpy, jax Array, device_ndarray, nested lists) to a jax Array
+already resident on device; "zero-copy" holds for jax inputs.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ai_wrapper:
+    """Host/device array wrapper with dtype/shape validation hooks."""
+
+    def __init__(self, ai_arr):
+        if hasattr(ai_arr, "array") and isinstance(ai_arr.array, jax.Array):
+            self._arr = ai_arr.array
+        else:
+            self._arr = jnp.asarray(ai_arr)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._arr.dtype)
+
+    @property
+    def shape(self):
+        return tuple(self._arr.shape)
+
+    @property
+    def c_contiguous(self) -> bool:
+        return True
+
+    @property
+    def array(self) -> jax.Array:
+        return self._arr
+
+    def validate_shape_dtype(self, expected_dims=None, expected_dtype=None):
+        """Ref cai_wrapper.py ``validate_shape_dtype``."""
+        if expected_dims is not None and len(self.shape) != expected_dims:
+            raise ValueError(
+                f"unexpected shape {self.shape} - expected {expected_dims} dims"
+            )
+        if expected_dtype is not None and self.dtype != np.dtype(expected_dtype):
+            raise ValueError(
+                f"unexpected dtype {self.dtype} - expected {expected_dtype}"
+            )
+        return self
+
+
+class cai_wrapper(ai_wrapper):
+    """Device-array wrapper (ref common/cai_wrapper.py:21); on TPU both host
+    and device inputs land in HBM, so this is ai_wrapper with the same name
+    kept for API parity."""

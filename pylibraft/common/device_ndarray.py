@@ -1,0 +1,87 @@
+"""RMM device_ndarray analog backed by a jax Array in HBM.
+
+Ref: python/pylibraft/pylibraft/common/device_ndarray.py:24-147 — same
+constructor-from-host-array semantics and ``empty/zeros/ones`` factories,
+``copy_to_host`` and the array-protocol export. CUDA-array-interface export is
+replaced by ``__array__`` + the ``.array`` jax handle (zero-copy on device).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class device_ndarray:
+    """Device-resident ndarray; thin wrapper over a jax Array."""
+
+    def __init__(self, np_ndarray):
+        """Copy a host array to device (ref device_ndarray.py:24-63)."""
+        self._array = jnp.asarray(np_ndarray)
+
+    @classmethod
+    def from_jax(cls, arr: jax.Array) -> "device_ndarray":
+        out = cls.__new__(cls)
+        out._array = arr
+        return out
+
+    @classmethod
+    def empty(cls, shape, dtype=np.float32, order="C"):
+        """Ref device_ndarray.py:65-85 (rmm alloc → here device zeros)."""
+        return cls.from_jax(jnp.zeros(shape, dtype=dtype))
+
+    @classmethod
+    def zeros(cls, shape, dtype=np.float32, order="C"):
+        return cls.from_jax(jnp.zeros(shape, dtype=dtype))
+
+    @classmethod
+    def ones(cls, shape, dtype=np.float32, order="C"):
+        return cls.from_jax(jnp.ones(shape, dtype=dtype))
+
+    @property
+    def array(self) -> jax.Array:
+        return self._array
+
+    @property
+    def shape(self):
+        return tuple(self._array.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._array.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self._array.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._array.size)
+
+    @property
+    def c_contiguous(self) -> bool:
+        """Row-major; jax Arrays are logically C-contiguous
+        (ref device_ndarray.py:96-110)."""
+        return True
+
+    @property
+    def f_contiguous(self) -> bool:
+        return self._array.ndim <= 1
+
+    def copy_to_host(self) -> np.ndarray:
+        """Ref device_ndarray.py:139-147."""
+        return np.asarray(self._array)
+
+    def __array__(self, dtype=None):
+        host = self.copy_to_host()
+        return host if dtype is None else host.astype(dtype)
+
+    def __len__(self) -> int:
+        return int(self._array.shape[0])
+
+    def __getitem__(self, item):
+        return device_ndarray.from_jax(self._array[item])
+
+    def __repr__(self) -> str:
+        return f"device_ndarray({self._array!r})"

@@ -1,0 +1,56 @@
+"""DeviceResources handle + auto_sync_handle decorator.
+
+Ref: python/pylibraft/pylibraft/common/handle.pyx:34 (``DeviceResources``
+wrapping ``raft::device_resources``) and :209 (``auto_sync_handle`` — creates
+a default handle when the caller passes none and syncs it after the call).
+On TPU the handle wraps ``raft_tpu.core.resources.DeviceResources`` (device,
+mesh, PRNG stream); ``sync()`` drains XLA's async dispatch queue.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from raft_tpu.core.resources import DeviceResources as _TpuResources
+
+
+class Handle:
+    """Legacy name for DeviceResources (ref common/handle.pyx:232)."""
+
+    def __init__(self, n_streams: int = 0):
+        self._resources = _TpuResources()
+
+    def getHandle(self):
+        return self._resources
+
+    def sync(self) -> None:
+        """Block until all dispatched device work completes
+        (ref handle.pyx ``sync`` → stream sync; here an XLA barrier)."""
+        import jax
+
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+
+class DeviceResources(Handle):
+    """Ref common/handle.pyx:34 — the handle passed to every pylibraft call."""
+
+
+def auto_sync_handle(f):
+    """Ref common/handle.pyx:209 — inject a fresh handle when absent, sync
+    after the wrapped call returns."""
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        sync_after = "handle" not in kwargs or kwargs["handle"] is None
+        if sync_after:
+            kwargs["handle"] = DeviceResources()
+        handle = kwargs["handle"]
+        ret = f(*args, **kwargs)
+        if sync_after and hasattr(handle, "sync"):
+            handle.sync()
+        return ret
+
+    return wrapper

@@ -1,0 +1,53 @@
+"""Input validation helpers, ref python/pylibraft/pylibraft/common/
+input_validation.py (row/col-major checks over array interfaces)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _shape_dtype(x):
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return tuple(x.shape), np.dtype(x.dtype)
+    arr = np.asarray(x)
+    return arr.shape, arr.dtype
+
+
+def is_c_contiguous(cai) -> bool:
+    """jax Arrays and our device_ndarray are logically row-major."""
+    if hasattr(cai, "c_contiguous"):
+        return bool(cai.c_contiguous)
+    if isinstance(cai, np.ndarray):
+        return cai.flags["C_CONTIGUOUS"]
+    return True
+
+
+def is_f_contiguous(cai) -> bool:
+    if isinstance(cai, np.ndarray):
+        return cai.flags["F_CONTIGUOUS"]
+    shape, _ = _shape_dtype(cai)
+    return len(shape) <= 1
+
+
+def do_cols_match(a, b) -> bool:
+    sa, _ = _shape_dtype(a)
+    sb, _ = _shape_dtype(b)
+    return sa[1] == sb[1]
+
+
+def do_rows_match(a, b) -> bool:
+    sa, _ = _shape_dtype(a)
+    sb, _ = _shape_dtype(b)
+    return sa[0] == sb[0]
+
+
+def do_shapes_match(a, b) -> bool:
+    sa, _ = _shape_dtype(a)
+    sb, _ = _shape_dtype(b)
+    return sa == sb
+
+
+def do_dtypes_match(a, b) -> bool:
+    _, da = _shape_dtype(a)
+    _, db = _shape_dtype(b)
+    return da == db

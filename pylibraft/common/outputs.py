@@ -1,0 +1,46 @@
+"""Output auto-conversion, ref python/pylibraft/pylibraft/common/outputs.py.
+
+The reference converts returned device_ndarrays to the user's preferred array
+type via a configurable output_as hook; we keep the same surface with
+``device_ndarray`` (default), ``"array"`` (jax Array) or a callable.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from pylibraft.common.device_ndarray import device_ndarray
+
+_output_config = {"output_as": "device_ndarray"}
+
+
+def set_output_as(output_as) -> None:
+    """Ref common/outputs.py ``set_output_as`` — 'device_ndarray', 'array',
+    or a callable applied to each returned device array."""
+    _output_config["output_as"] = output_as
+
+
+def _convert(value):
+    out_as = _output_config["output_as"]
+    if isinstance(value, jax.Array):
+        if out_as == "device_ndarray":
+            return device_ndarray.from_jax(value)
+        if out_as == "array":
+            return value
+        if callable(out_as):
+            return out_as(value)
+    if isinstance(value, tuple):
+        return tuple(_convert(v) for v in value)
+    return value
+
+
+def auto_convert_output(f):
+    """Ref common/outputs.py ``auto_convert_output`` decorator."""
+
+    @functools.wraps(f)
+    def wrapper(*args, **kwargs):
+        return _convert(f(*args, **kwargs))
+
+    return wrapper

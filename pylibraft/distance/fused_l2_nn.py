@@ -1,0 +1,33 @@
+"""Fused L2 nearest-neighbor argmin, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/distance/fused_l2_nn.pyx:66
+(``fused_l2_nn_argmin(X, Y, out=None, sqrt=True)``) →
+raft::runtime ``fused_l2_nn_min_arg`` (cpp/src/distance/fused_l2_min_arg.cu).
+TPU path: one MXU matmul + argmin epilogue (raft_tpu.distance.fused_l2_nn).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.distance.fused_l2_nn import fused_l2_nn_argmin as _argmin
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+
+
+@auto_sync_handle
+@auto_convert_output
+def fused_l2_nn_argmin(X, Y, out=None, sqrt=True, handle=None):
+    """For each row of X, the index of the nearest row of Y (int32)."""
+    x = cai_wrapper(X)
+    y = cai_wrapper(Y)
+    if x.shape[1] != y.shape[1]:
+        raise ValueError("Inputs must have same number of columns")
+    idx = _argmin(x.array, y.array, sqrt=sqrt)
+    if out is not None:
+        if isinstance(out, np.ndarray):
+            np.copyto(out, np.asarray(idx))
+        elif hasattr(out, "_array"):
+            out._array = idx.astype(out._array.dtype)
+        return out
+    return idx

@@ -1,0 +1,55 @@
+"""Runtime-metric pairwise distance, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/distance/pairwise_distance.pyx:62-83
+(metric-name dict) and :93 (``def distance``) → raft::runtime::distance::
+pairwise_distance (cpp/src/distance/pairwise_distance.cu). On TPU the
+expanded metrics are a single MXU gram matmul + norms epilogue, unexpanded
+metrics a blocked elementwise reduction (raft_tpu.distance.pairwise).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.distance import pairwise as _pairwise
+from raft_tpu.distance.distance_types import DISTANCE_TYPES, DistanceType
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+
+SUPPORTED_DISTANCES = [
+    "euclidean", "l1", "cityblock", "l2", "inner_product", "chebyshev",
+    "minkowski", "canberra", "kl_divergence", "correlation", "russellrao",
+    "hellinger", "lp", "hamming", "jensenshannon", "cosine", "sqeuclidean",
+]
+
+
+@auto_sync_handle
+@auto_convert_output
+def distance(X, Y, out=None, metric="euclidean", p=2.0, handle=None):
+    """Compute pairwise distances between X and Y; ref
+    distance/pairwise_distance.pyx:93-171. ``out``, when given, receives the
+    result (host copy for numpy outputs) and is returned."""
+    if isinstance(metric, str):
+        if metric not in DISTANCE_TYPES:
+            raise ValueError(f"metric {metric!r} is not supported")
+        metric_dt = DISTANCE_TYPES[metric]
+    else:
+        metric_dt = DistanceType(metric)
+
+    x = cai_wrapper(X)
+    y = cai_wrapper(Y)
+    if x.shape[1] != y.shape[1]:
+        raise ValueError("Inputs must have same number of columns")
+
+    d = _pairwise.distance(x.array, y.array, metric=metric_dt, metric_arg=p)
+
+    if out is not None:
+        if isinstance(out, np.ndarray):
+            np.copyto(out, np.asarray(d))
+        elif hasattr(out, "_array"):
+            out._array = d.astype(out._array.dtype)
+        return out
+    return d
+
+
+pairwise_distance = distance

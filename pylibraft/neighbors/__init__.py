@@ -1,0 +1,10 @@
+"""pylibraft.neighbors — brute-force + ANN indexes.
+
+Ref: python/pylibraft/pylibraft/neighbors/__init__.py (exports brute_force,
+ivf_flat, ivf_pq, refine).
+"""
+
+from pylibraft.neighbors import brute_force, ivf_flat, ivf_pq
+from pylibraft.neighbors.refine import refine
+
+__all__ = ["brute_force", "ivf_flat", "ivf_pq", "refine"]

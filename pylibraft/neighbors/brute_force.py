@@ -1,0 +1,46 @@
+"""Brute-force exact kNN, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/neighbors/brute_force.pyx:75 (``knn``) →
+raft::runtime brute-force (cpp/src/neighbors/brute_force_knn_int64_t_float.cu)
+→ tiled pairwise + select_k (neighbors/detail/knn_brute_force.cuh:51).
+TPU path: raft_tpu.neighbors.brute_force (fused L2 matmul + top-k tiles).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.neighbors import brute_force as _bf
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+from pylibraft.distance.pairwise_distance import DISTANCE_TYPES
+
+
+@auto_sync_handle
+@auto_convert_output
+def knn(dataset, queries, k=None, indices=None, distances=None,
+        metric="sqeuclidean", metric_arg=2.0, global_id_offset=0,
+        handle=None):
+    """Exact nearest neighbors; returns ``(distances, indices)`` like the
+    reference (brute_force.pyx:179)."""
+    ds = cai_wrapper(dataset)
+    q = cai_wrapper(queries)
+    if k is None:
+        if indices is not None:
+            k = np.asarray(indices).shape[1]
+        elif distances is not None:
+            k = np.asarray(distances).shape[1]
+        else:
+            raise ValueError("k must be given or deducible from indices/distances")
+
+    metric_dt = DISTANCE_TYPES[metric] if isinstance(metric, str) else metric
+    d, i = _bf.knn(ds.array, q.array, int(k), metric=metric_dt,
+                   metric_arg=metric_arg)
+    if global_id_offset:
+        i = i + int(global_id_offset)
+
+    if distances is not None and isinstance(distances, np.ndarray):
+        np.copyto(distances, np.asarray(d))
+    if indices is not None and isinstance(indices, np.ndarray):
+        np.copyto(indices, np.asarray(i).astype(indices.dtype))
+    return d, i

@@ -1,0 +1,159 @@
+"""IVF-Flat ANN index, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/neighbors/ivf_flat/ivf_flat.pyx —
+``IndexParams``, ``Index``, ``build``, ``extend``, ``SearchParams``,
+``search``, ``save``, ``load``. Backed by raft_tpu.neighbors.ivf_flat
+(padded per-list storage + masked interleaved scan on TPU).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.neighbors import ivf_flat as _impl
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+from pylibraft.neighbors.common import (
+    _check_input_array,
+    _get_metric,
+    _get_metric_string,
+)
+
+
+class IndexParams:
+    """Ref ivf_flat.pyx IndexParams; metric accepts the ANN metric strings
+    {"sqeuclidean", "euclidean", "inner_product"}."""
+
+    def __init__(self, *, n_lists=1024, metric="sqeuclidean",
+                 kmeans_n_iters=20, kmeans_trainset_fraction=0.5,
+                 add_data_on_build=True, adaptive_centers=False):
+        self.params = _impl.IndexParams(
+            n_lists=n_lists,
+            metric=_get_metric(metric),
+            kmeans_n_iters=kmeans_n_iters,
+            kmeans_trainset_fraction=kmeans_trainset_fraction,
+            add_data_on_build=add_data_on_build,
+            adaptive_centers=adaptive_centers,
+        )
+
+    @property
+    def n_lists(self):
+        return self.params.n_lists
+
+    @property
+    def metric(self):
+        return _get_metric_string(self.params.metric)
+
+    @property
+    def kmeans_n_iters(self):
+        return self.params.kmeans_n_iters
+
+    @property
+    def kmeans_trainset_fraction(self):
+        return self.params.kmeans_trainset_fraction
+
+    @property
+    def add_data_on_build(self):
+        return self.params.add_data_on_build
+
+    @property
+    def adaptive_centers(self):
+        return self.params.adaptive_centers
+
+
+class SearchParams:
+    """Ref ivf_flat.pyx SearchParams(n_probes=20)."""
+
+    def __init__(self, *, n_probes=20):
+        self.params = _impl.SearchParams(n_probes=n_probes)
+
+    @property
+    def n_probes(self):
+        return self.params.n_probes
+
+    def __repr__(self):
+        return f"SearchParams(n_probes={self.n_probes})"
+
+
+class Index:
+    """Handle over the device-resident index (ref ivf_flat.pyx Index)."""
+
+    def __init__(self, index=None):
+        self._index = index
+        self.trained = index is not None
+
+    @property
+    def size(self):
+        return 0 if self._index is None else self._index.size
+
+    @property
+    def dim(self):
+        return 0 if self._index is None else self._index.dim
+
+    @property
+    def n_lists(self):
+        return 0 if self._index is None else self._index.n_lists
+
+    @property
+    def metric(self):
+        return None if self._index is None else _get_metric_string(self._index.metric)
+
+    @property
+    def adaptive_centers(self):
+        return False if self._index is None else self._index.adaptive_centers
+
+    def __repr__(self):
+        attrs = ", ".join(
+            f"{k}={getattr(self, k)}"
+            for k in ["size", "dim", "n_lists", "metric"])
+        return f"Index(type=IVF-Flat, {attrs})"
+
+
+@auto_sync_handle
+@auto_convert_output
+def build(index_params: IndexParams, dataset, handle=None) -> Index:
+    """Ref ivf_flat.pyx ``build`` — trains balanced kmeans centers and fills
+    the inverted lists."""
+    ds = cai_wrapper(dataset)
+    _check_input_array(ds, [np.dtype("float32"), np.dtype("byte"),
+                            np.dtype("ubyte")])
+    return Index(_impl.build(index_params.params, ds.array))
+
+
+@auto_sync_handle
+@auto_convert_output
+def extend(index: Index, new_vectors, new_indices, handle=None) -> Index:
+    """Ref ivf_flat.pyx ``extend``."""
+    v = cai_wrapper(new_vectors)
+    i = cai_wrapper(new_indices)
+    _check_input_array(v, [np.dtype("float32"), np.dtype("byte"),
+                           np.dtype("ubyte")], exp_cols=index.dim)
+    index._index = _impl.extend(index._index, v.array, i.array)
+    return index
+
+
+@auto_sync_handle
+@auto_convert_output
+def search(search_params: SearchParams, index: Index, queries, k: int,
+           neighbors=None, distances=None, memory_resource=None, handle=None):
+    """Ref ivf_flat.pyx ``search`` — returns ``(distances, neighbors)``."""
+    if not index.trained:
+        raise ValueError("Index needs to be built before calling search.")
+    q = cai_wrapper(queries)
+    _check_input_array(q, [np.dtype("float32")], exp_cols=index.dim)
+    d, n = _impl.search(search_params.params, index._index, q.array, k)
+    if distances is not None and isinstance(distances, np.ndarray):
+        np.copyto(distances, np.asarray(d))
+    if neighbors is not None and isinstance(neighbors, np.ndarray):
+        np.copyto(neighbors, np.asarray(n).astype(neighbors.dtype))
+    return d, n
+
+
+def save(filename: str, index: Index, handle=None) -> None:
+    """Ref ivf_flat.pyx ``save`` → versioned serialized index."""
+    _impl.save(filename, index._index)
+
+
+def load(filename: str, handle=None) -> Index:
+    """Ref ivf_flat.pyx ``load``."""
+    return Index(_impl.load(filename))

@@ -1,0 +1,43 @@
+"""Candidate refinement (exact re-ranking), pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/neighbors/refine.pyx:173 (``refine``) →
+raft::neighbors::refine (neighbors/refine.cuh). Returns
+``(distances, indices)`` like the reference (refine.pyx:323).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.neighbors import refine as _refine_mod
+
+# raft_tpu.neighbors re-exports the refine *function* under the same name;
+# resolve to the module's callable either way.
+_impl_refine = _refine_mod.refine if hasattr(_refine_mod, "refine") else _refine_mod
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+from pylibraft.neighbors.common import _get_metric
+
+
+@auto_sync_handle
+@auto_convert_output
+def refine(dataset, queries, candidates, k=None, indices=None,
+           distances=None, metric="sqeuclidean", handle=None):
+    ds = cai_wrapper(dataset)
+    q = cai_wrapper(queries)
+    cand = cai_wrapper(candidates)
+    if k is None:
+        if indices is not None:
+            k = np.asarray(indices).shape[1]
+        elif distances is not None:
+            k = np.asarray(distances).shape[1]
+        else:
+            raise ValueError("k must be given or deducible from indices/distances")
+
+    d, i = _impl_refine(ds.array, q.array, cand.array, int(k),
+                        metric=_get_metric(metric))
+    if distances is not None and isinstance(distances, np.ndarray):
+        np.copyto(distances, np.asarray(d))
+    if indices is not None and isinstance(indices, np.ndarray):
+        np.copyto(indices, np.asarray(i).astype(indices.dtype))
+    return d, i

@@ -1,0 +1,36 @@
+"""RMAT rectangular graph generator, pylibraft surface.
+
+Ref: python/pylibraft/pylibraft/random/rmat_rectangular_generator.pyx:80
+(``rmat(out, theta, r_scale, c_scale, seed)``) → raft::random::
+rmat_rectangular_gen (cpp/src/random/rmat_rectangular_generator.cu).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from raft_tpu.random.rmat import rmat_rectangular_gen as _gen
+from raft_tpu.random.rng_state import RngState
+
+from pylibraft.common import auto_convert_output, auto_sync_handle, cai_wrapper
+
+
+@auto_sync_handle
+@auto_convert_output
+def rmat(out, theta, r_scale, c_scale, seed=12345, handle=None):
+    """Fill ``out`` (n_edges, 2) with RMAT edges; returns out. Same in-place
+    contract as the reference (out dtype int32/int64)."""
+    t = cai_wrapper(theta)
+    n_edges = np.asarray(out).shape[0] if not hasattr(out, "shape") else out.shape[0]
+    src, dst = _gen(RngState(seed=int(seed)), t.array, int(r_scale),
+                    int(c_scale), int(n_edges))
+    edges = np.stack([np.asarray(src), np.asarray(dst)], axis=1)
+    if isinstance(out, np.ndarray):
+        np.copyto(out, edges.astype(out.dtype))
+        return out
+    if hasattr(out, "_array"):
+        import jax.numpy as jnp
+
+        out._array = jnp.asarray(edges.astype(out.dtype))
+        return out
+    return edges

@@ -10,13 +10,16 @@ from raft_tpu.neighbors.brute_force import (
     knn_merge_parts,
     tiled_brute_force_knn,
 )
+from raft_tpu.neighbors import ball_cover
 from raft_tpu.neighbors import ivf_flat
 from raft_tpu.neighbors import ivf_pq
+from raft_tpu.neighbors.ball_cover import BallCoverIndex
 from raft_tpu.neighbors.refine import refine
 from raft_tpu.neighbors.epsilon_neighborhood import eps_neighbors_l2sq
 
 __all__ = [
     "IndexParams", "SearchParams",
+    "BallCoverIndex", "ball_cover",
     "brute_force", "knn", "fused_l2_knn", "knn_merge_parts",
     "tiled_brute_force_knn",
     "ivf_flat", "ivf_pq", "refine", "eps_neighbors_l2sq",

@@ -1,0 +1,106 @@
+"""Ball-cover tests: exactness vs brute force, mirroring the reference's
+cpp/test/neighbors/ball_cover.cu (compares against a naive kNN and asserts
+full agreement on 2D/3D L2 and haversine)."""
+
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ball_cover
+
+
+def _haversine(x, y):
+    lat1, lon1 = x[:, None, 0], x[:, None, 1]
+    lat2, lon2 = y[None, :, 0], y[None, :, 1]
+    a = (np.sin(0.5 * (lat1 - lat2)) ** 2
+         + np.cos(lat1) * np.cos(lat2) * np.sin(0.5 * (lon1 - lon2)) ** 2)
+    return 2.0 * np.arcsin(np.sqrt(np.clip(a, 0.0, 1.0)))
+
+
+class TestBuild:
+    def test_index_shapes(self, rng):
+        X = rng.normal(size=(400, 2)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+        assert idx.index_trained
+        assert idx.n_landmarks == 20  # sqrt(400)
+        assert int(np.asarray(idx.group_sizes).sum()) == 400
+        # every row appears exactly once across groups
+        members = np.asarray(idx.group_indices)
+        members = members[members >= 0]
+        assert np.array_equal(np.sort(members), np.arange(400))
+
+    def test_radii_cover_members(self, rng):
+        X = rng.normal(size=(300, 3)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+        landmarks = np.asarray(idx.landmarks)
+        radii = np.asarray(idx.radii)
+        gi = np.asarray(idx.group_indices)
+        sizes = np.asarray(idx.group_sizes)
+        for l in range(idx.n_landmarks):
+            for j in range(sizes[l]):
+                d = np.linalg.norm(X[gi[l, j]] - landmarks[l])
+                assert d <= radii[l] + 1e-5
+
+    def test_rejects_high_dim(self, rng):
+        X = rng.normal(size=(100, 8)).astype(np.float32)
+        with pytest.raises(Exception):
+            ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+
+
+class TestKnnQuery:
+    @pytest.mark.parametrize("dim", [2, 3])
+    @pytest.mark.parametrize("k", [1, 7])
+    def test_exact_l2(self, rng, dim, k):
+        X = rng.normal(size=(500, dim)).astype(np.float32)
+        Q = rng.normal(size=(40, dim)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+        d, i = ball_cover.knn_query(idx, Q, k)
+        d, i = np.asarray(d), np.asarray(i)
+        ref = cdist(Q, X)
+        truth_d = np.sort(ref, axis=1)[:, :k]
+        np.testing.assert_allclose(d, truth_d, rtol=1e-4, atol=1e-4)
+        # indices must achieve the same distances (ties allowed)
+        achieved = np.take_along_axis(ref, i, axis=1)
+        np.testing.assert_allclose(achieved, truth_d, rtol=1e-4, atol=1e-4)
+
+    def test_exact_haversine(self, rng):
+        lat = rng.uniform(-np.pi / 2, np.pi / 2, size=(300, 1))
+        lon = rng.uniform(-np.pi, np.pi, size=(300, 1))
+        X = np.concatenate([lat, lon], axis=1).astype(np.float32)
+        Q = X[:25] + 0.01
+        idx = ball_cover.build_index(X, DistanceType.Haversine)
+        d, i = ball_cover.knn_query(idx, Q, 5)
+        ref = _haversine(Q.astype(np.float64), X.astype(np.float64))
+        truth_d = np.sort(ref, axis=1)[:, :5]
+        achieved = np.take_along_axis(ref, np.asarray(i), axis=1)
+        np.testing.assert_allclose(achieved, truth_d, rtol=1e-3, atol=1e-4)
+
+    def test_squared_metric_reports_squared(self, rng):
+        X = rng.normal(size=(200, 2)).astype(np.float32)
+        Q = rng.normal(size=(10, 2)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2Unexpanded)
+        d, _ = ball_cover.knn_query(idx, Q, 3)
+        truth = np.sort(cdist(Q, X, "sqeuclidean"), axis=1)[:, :3]
+        np.testing.assert_allclose(np.asarray(d), truth, rtol=1e-4, atol=1e-4)
+
+    def test_all_knn_query(self, rng):
+        X = rng.normal(size=(250, 2)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+        d, i = ball_cover.all_knn_query(idx, 4)
+        # nearest neighbor of each point is itself at distance ~0 (expanded
+        # L2 in fp32 leaves ~1e-3 of cancellation noise after sqrt, the same
+        # tolerance class the reference's matchers allow)
+        np.testing.assert_allclose(np.asarray(d)[:, 0], 0.0, atol=5e-3)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], np.arange(250))
+
+
+class TestEpsNn:
+    def test_adjacency(self, rng):
+        X = rng.normal(size=(150, 2)).astype(np.float32)
+        Q = rng.normal(size=(20, 2)).astype(np.float32)
+        idx = ball_cover.build_index(X, DistanceType.L2SqrtUnexpanded)
+        adj, vd = ball_cover.eps_nn(idx, Q, eps=0.5)
+        ref = cdist(Q, X) <= 0.5
+        np.testing.assert_array_equal(np.asarray(adj), ref)
+        np.testing.assert_array_equal(np.asarray(vd), ref.sum(axis=1))

@@ -1,0 +1,290 @@
+"""Tests for the pylibraft-compatible API layer.
+
+Modeled on the reference's python tests
+(python/pylibraft/pylibraft/test/test_distance.py, test_ivf_pq.py,
+test_brute_force.py, test_kmeans.py): compare against scipy/numpy ground
+truth on small data, recall thresholds for ANN.
+"""
+
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+
+def _recall(found, truth):
+    hits = sum(
+        len(np.intersect1d(found[r], truth[r])) for r in range(truth.shape[0])
+    )
+    return hits / truth.size
+
+
+class TestCommon:
+    def test_device_ndarray_roundtrip(self, rng):
+        from pylibraft.common import device_ndarray
+
+        host = rng.normal(size=(5, 4)).astype(np.float32)
+        dev = device_ndarray(host)
+        assert dev.shape == (5, 4)
+        assert dev.dtype == np.float32
+        assert dev.c_contiguous
+        np.testing.assert_array_equal(dev.copy_to_host(), host)
+
+    def test_device_ndarray_factories(self):
+        from pylibraft.common import device_ndarray
+
+        z = device_ndarray.zeros((3, 2))
+        assert z.copy_to_host().sum() == 0.0
+        o = device_ndarray.ones((3, 2))
+        assert o.copy_to_host().sum() == 6.0
+
+    def test_handle_sync(self):
+        from pylibraft.common import DeviceResources
+
+        h = DeviceResources()
+        h.sync()  # must not raise
+
+    def test_output_as_array(self, rng):
+        import jax
+
+        from pylibraft.common import set_output_as
+        from pylibraft.distance import pairwise_distance
+
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        try:
+            set_output_as("array")
+            out = pairwise_distance(x, x, metric="euclidean")
+            assert isinstance(out, jax.Array)
+        finally:
+            set_output_as("device_ndarray")
+
+
+class TestDistance:
+    @pytest.mark.parametrize("metric", [
+        "euclidean", "sqeuclidean", "cityblock", "chebyshev", "canberra",
+        "cosine", "braycurtis",
+    ])
+    def test_distance_matches_scipy(self, rng, metric):
+        from pylibraft.distance import pairwise_distance
+
+        x = np.abs(rng.normal(size=(30, 8))).astype(np.float32)
+        y = np.abs(rng.normal(size=(20, 8))).astype(np.float32)
+        got = np.asarray(pairwise_distance(x, y, metric=metric))
+        want = cdist(x.astype(np.float64), y.astype(np.float64), metric)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    def test_distance_out_param(self, rng):
+        from pylibraft.distance import pairwise_distance
+
+        x = rng.normal(size=(10, 4)).astype(np.float32)
+        out = np.zeros((10, 10), np.float32)
+        ret = pairwise_distance(x, x, out=out, metric="euclidean")
+        assert ret is out
+        assert out.max() > 0
+
+    def test_unsupported_metric_raises(self, rng):
+        from pylibraft.distance import pairwise_distance
+
+        x = rng.normal(size=(4, 3)).astype(np.float32)
+        with pytest.raises(ValueError):
+            pairwise_distance(x, x, metric="not_a_metric")
+
+    def test_fused_l2_nn_argmin(self, rng):
+        from pylibraft.distance import fused_l2_nn_argmin
+
+        x = rng.normal(size=(50, 6)).astype(np.float32)
+        y = rng.normal(size=(12, 6)).astype(np.float32)
+        got = np.asarray(fused_l2_nn_argmin(x, y, sqrt=True))
+        want = cdist(x, y).argmin(axis=1)
+        np.testing.assert_array_equal(got, want)
+
+
+class TestBruteForce:
+    def test_knn(self, rng):
+        from pylibraft.neighbors.brute_force import knn
+
+        db = rng.normal(size=(200, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        d, i = knn(db, q, k=5)
+        d, i = np.asarray(d), np.asarray(i)
+        truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :5]
+        assert _recall(i, truth) == 1.0
+        assert np.all(np.diff(d, axis=1) >= 0)
+
+    def test_knn_k_from_indices(self, rng):
+        from pylibraft.neighbors.brute_force import knn
+
+        db = rng.normal(size=(50, 8)).astype(np.float32)
+        q = rng.normal(size=(4, 8)).astype(np.float32)
+        idx = np.zeros((4, 3), np.int64)
+        dist = np.zeros((4, 3), np.float32)
+        knn(db, q, indices=idx, distances=dist)
+        assert idx.max() > 0
+        assert dist.max() > 0
+
+
+class TestIvfFlat:
+    def test_build_search_recall(self, rng):
+        from pylibraft.neighbors import ivf_flat
+
+        db = rng.normal(size=(1000, 16)).astype(np.float32)
+        q = rng.normal(size=(50, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, metric="sqeuclidean")
+        index = ivf_flat.build(params, db)
+        assert index.trained
+        assert index.size == 1000
+        assert index.dim == 16
+        assert index.metric == "sqeuclidean"
+        d, n = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), index, q, 10)
+        truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :10]
+        assert _recall(np.asarray(n), truth) > 0.99  # all lists probed
+
+    def test_save_load(self, rng, tmp_path):
+        from pylibraft.neighbors import ivf_flat
+
+        db = rng.normal(size=(300, 8)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8)
+        index = ivf_flat.build(params, db)
+        f = str(tmp_path / "ivf_flat.bin")
+        ivf_flat.save(f, index)
+        loaded = ivf_flat.load(f)
+        assert loaded.size == index.size
+        q = db[:5]
+        d0, n0 = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), index, q, 3)
+        d1, n1 = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), loaded, q, 3)
+        np.testing.assert_array_equal(np.asarray(n0), np.asarray(n1))
+
+
+class TestIvfPq:
+    def test_build_search_recall(self, rng):
+        from pylibraft.neighbors import ivf_pq
+
+        # Python-side parity bar: recall > 0.7 (ref test_ivf_pq.py:191).
+        db = rng.normal(size=(2000, 16)).astype(np.float32)
+        q = rng.normal(size=(50, 16)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, metric="sqeuclidean",
+                                    pq_dim=8, pq_bits=8)
+        index = ivf_pq.build(params, db)
+        assert index.trained
+        assert index.pq_dim == 8
+        assert index.pq_bits == 8
+        d, n = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 10)
+        truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :10]
+        assert _recall(np.asarray(n), truth) > 0.7
+
+    def test_search_with_refine(self, rng):
+        from pylibraft.neighbors import ivf_pq, refine
+
+        db = rng.normal(size=(1500, 16)).astype(np.float32)
+        q = rng.normal(size=(30, 16)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=10, metric="sqeuclidean", pq_dim=4)
+        index = ivf_pq.build(params, db)
+        _, cand = ivf_pq.search(ivf_pq.SearchParams(n_probes=10), index, q, 30)
+        d, n = refine(db, q, np.asarray(cand), k=10, metric="sqeuclidean")
+        truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :10]
+        assert _recall(np.asarray(n), truth) >= 0.7
+
+    def test_save_load(self, rng, tmp_path):
+        from pylibraft.neighbors import ivf_pq
+
+        db = rng.normal(size=(500, 8)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=5, pq_dim=4)
+        index = ivf_pq.build(params, db)
+        f = str(tmp_path / "ivf_pq.bin")
+        ivf_pq.save(f, index)
+        loaded = ivf_pq.load(f)
+        assert loaded.size == index.size
+        assert loaded.pq_dim == index.pq_dim
+
+    def test_bad_codebook_kind(self):
+        from pylibraft.neighbors import ivf_pq
+
+        with pytest.raises(ValueError):
+            ivf_pq.IndexParams(codebook_kind="bogus")
+
+
+class TestKmeans:
+    def test_fit(self, rng):
+        from pylibraft.cluster.kmeans import KMeansParams, fit
+
+        blob = np.concatenate([
+            rng.normal(loc=0.0, size=(100, 4)),
+            rng.normal(loc=8.0, size=(100, 4)),
+        ]).astype(np.float32)
+        params = KMeansParams(n_clusters=2, max_iter=50, seed=1)
+        centroids, inertia, n_iter = fit(params, blob)
+        c = np.sort(np.asarray(centroids)[:, 0])
+        assert abs(c[0] - 0.0) < 1.0 and abs(c[1] - 8.0) < 1.0
+        assert inertia > 0
+        assert n_iter >= 1
+
+    def test_cluster_cost(self, rng):
+        from pylibraft.cluster.kmeans import cluster_cost
+
+        x = rng.normal(size=(100, 4)).astype(np.float32)
+        c = x[:3].copy()
+        cost = cluster_cost(x, c)
+        assert cost > 0
+
+    def test_init_plus_plus(self, rng):
+        from pylibraft.cluster.kmeans import init_plus_plus
+
+        x = rng.normal(size=(200, 4)).astype(np.float32)
+        cents = np.asarray(init_plus_plus(x, n_clusters=5, seed=0))
+        assert cents.shape == (5, 4)
+        # chosen centers are actual data points
+        d = cdist(cents, x).min(axis=1)
+        np.testing.assert_allclose(d, 0, atol=1e-5)
+
+    def test_init_plus_plus_exclusive_args(self, rng):
+        from pylibraft.cluster.kmeans import init_plus_plus
+
+        x = rng.normal(size=(20, 4)).astype(np.float32)
+        cents = np.zeros((5, 4), np.float32)
+        with pytest.raises(RuntimeError):
+            init_plus_plus(x, n_clusters=4, centroids=cents)
+
+    def test_compute_new_centroids(self, rng):
+        from pylibraft.cluster.kmeans import compute_new_centroids
+
+        x = rng.normal(size=(100, 4)).astype(np.float32)
+        c = x[:4].copy()
+        labels = cdist(x, c).argmin(axis=1).astype(np.int32)
+        new = np.zeros_like(c)
+        compute_new_centroids(x, c, labels, new)
+        want = np.stack([x[labels == j].mean(axis=0) for j in range(4)])
+        np.testing.assert_allclose(new, want, rtol=1e-4, atol=1e-5)
+
+    def test_compute_new_centroids_weight_per_cluster(self, rng):
+        from pylibraft.cluster.kmeans import compute_new_centroids
+
+        x = rng.normal(size=(60, 3)).astype(np.float32)
+        c = x[:3].copy()
+        labels = cdist(x, c).argmin(axis=1).astype(np.int32)
+        new = np.zeros_like(c)
+        wpc = np.zeros((3,), np.float32)
+        compute_new_centroids(x, c, labels, new, weight_per_cluster=wpc)
+        np.testing.assert_allclose(wpc, np.bincount(labels, minlength=3))
+
+    def test_kmeans_params_fields(self):
+        from pylibraft.cluster.kmeans import InitMethod, KMeansParams
+
+        p = KMeansParams(n_clusters=7, max_iter=12, tol=1e-3, seed=9,
+                         init=InitMethod.Random)
+        assert p.n_clusters == 7
+        assert p.max_iter == 12
+        assert p.seed == 9
+        assert p.init == InitMethod.Random
+
+
+class TestRandom:
+    def test_rmat(self):
+        from pylibraft.random import rmat
+
+        theta = np.array([0.5, 0.2, 0.2, 0.1], np.float32)
+        out = np.zeros((1000, 2), np.int32)
+        ret = rmat(out, theta, 8, 8, seed=3)
+        assert ret is out
+        assert out.min() >= 0
+        assert out.max() < 256
+        # skew towards low ids from the (a,b,c,d) weighting
+        assert (out[:, 0] < 128).mean() > 0.55
