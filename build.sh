@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Build/test driver, the analog of the reference's root build.sh
+# (targets at build.sh:21-24: clean libraft pylibraft raft-dask docs tests
+# bench). Usage: ./build.sh [clean|native|tests|bench|all]...
+set -euo pipefail
+cd "$(dirname "$0")"
+
+do_clean() {
+  make -C native clean >/dev/null 2>&1 || true
+  find . -name __pycache__ -type d -prune -exec rm -rf {} +
+}
+
+do_native() {
+  # The host-native runtime (native/host_runtime.cpp → libraft_tpu_host.so),
+  # the analog of libraft.so's raft_runtime layer.
+  make -C native  # emits raft_tpu/_native/libraft_tpu_host.so
+}
+
+do_tests() {
+  python -m pytest tests/ -x -q
+}
+
+do_bench() {
+  python bench.py
+}
+
+[ $# -eq 0 ] && set -- native tests
+for target in "$@"; do
+  case "$target" in
+    clean) do_clean ;;
+    native|libraft) do_native ;;
+    tests) do_tests ;;
+    bench) do_bench ;;
+    all) do_native; do_tests; do_bench ;;
+    *) echo "unknown target: $target (clean|native|tests|bench|all)"; exit 1 ;;
+  esac
+done

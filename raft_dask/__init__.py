@@ -1,0 +1,14 @@
+"""``raft_dask``-compatible distributed bootstrap for the TPU build.
+
+Ref: python/raft-dask — the reference's second Python package, whose job is
+to form a multi-process communicator clique (NCCL + optional UCX endpoints
+over Dask workers, raft_dask/common/comms.py:37) and inject it into each
+worker's handle. On TPU the clique is the device mesh: intra-slice ranks are
+implicit (ICI), and multi-host process groups bootstrap through
+``jax.distributed.initialize`` (DCN). This package keeps the reference's
+module layout and class surface so downstream code can switch imports.
+"""
+
+from raft_dask.common import Comms, local_handle
+
+__all__ = ["Comms", "local_handle"]

@@ -1,0 +1,117 @@
+"""Cluster-wide communicator session.
+
+Ref: python/raft-dask/raft_dask/common/comms.py:37 — ``Comms`` bootstraps a
+NCCL clique (+ optional UCX endpoints) across Dask workers, stamps a
+``sessionId``, and each worker later retrieves its injected handle via
+``local_handle(sessionId)`` (:245). The call stack is SURVEY.md §3.5.
+
+TPU-native re-design: there is no clique to form — the accelerator fabric
+(ICI) is wired at program-compile time by XLA, and multi-host process groups
+come up with ``jax.distributed.initialize`` over DCN. ``Comms.init`` builds
+the ``jax.sharding.Mesh`` (local devices, or all processes' devices after a
+distributed initialize), creates a :class:`raft_tpu.core.DeviceResources`
+with a :class:`raft_tpu.comms.Comms` communicator injected, and registers it
+in a session table keyed by ``sessionId`` — preserving the reference's
+worker-side lookup idiom without any RPC.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+_SESSIONS: dict = {}
+
+
+def local_handle(sessionId: str):
+    """The session's injected handle (ref: raft_dask local_handle,
+    comms.py:245 — worker-side lookup of the handle built by init)."""
+    state = _SESSIONS.get(sessionId)
+    return None if state is None else state["handle"]
+
+
+class Comms:
+    """Communicator session over a TPU mesh.
+
+    Ref: raft_dask.common.Comms (comms.py:37): ``init()`` forms the clique
+    and injects per-worker handles, ``destroy()`` tears it down. Here
+    ``init()`` optionally bootstraps multi-host JAX (the NCCL-unique-id
+    dance of comms.py:135-204 collapses into ``jax.distributed.initialize``)
+    and builds the mesh + handle.
+
+    Parameters mirror the reference where meaningful; ``comms_p2p`` (UCX)
+    has no TPU analog — point-to-point rides ``lax.ppermute`` on the same
+    fabric — and is accepted for source compatibility.
+    """
+
+    def __init__(self, comms_p2p: bool = False, verbose: bool = False,
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        self.comms_p2p = comms_p2p
+        self.verbose = verbose
+        self._coord = coordinator_address
+        self._nprocs = num_processes
+        self._pid = process_id
+        self.sessionId = uuid.uuid4().hex
+        self.nccl_initialized = False  # name kept for API parity
+        self.ucx_initialized = False
+
+    # -- lifecycle (ref: comms.py Comms.init/destroy) ----------------------
+    def init(self, workers: Optional[Sequence] = None, axis: str = "data"):
+        """Form the mesh and inject a handle (ref: Comms.init, comms.py:170).
+
+        ``workers`` selects a subset of local devices (the reference's dask
+        worker list); default is every visible device.
+        """
+        from raft_tpu.comms.comms import build_comms, inject_comms_on_handle
+        from raft_tpu.core.resources import DeviceResources
+
+        if self._coord is not None and jax.process_count() == 1:
+            # Multi-host bootstrap over DCN — the analog of the NCCL
+            # unique-id broadcast (comms.py:135,355).
+            jax.distributed.initialize(
+                coordinator_address=self._coord,
+                num_processes=self._nprocs,
+                process_id=self._pid,
+            )
+
+        devices = list(workers) if workers is not None else jax.devices()
+        mesh = jax.sharding.Mesh(np.array(devices), (axis,))
+        handle = DeviceResources(mesh=mesh)
+        comms = build_comms(mesh, axis=axis)
+        inject_comms_on_handle(handle, comms)
+        _SESSIONS[self.sessionId] = {
+            "handle": handle, "mesh": mesh, "comms": comms,
+            "nworkers": len(devices),
+        }
+        self.nccl_initialized = True
+        if self.comms_p2p:
+            self.ucx_initialized = True
+        if self.verbose:
+            print(f"Initialized comms session {self.sessionId} over "
+                  f"{len(devices)} devices")
+        return self
+
+    def worker_info(self):
+        """Rank/size map (ref: comms.py worker_info — rank assignment)."""
+        state = _SESSIONS[self.sessionId]
+        return {
+            str(d): {"rank": i, "size": state["nworkers"]}
+            for i, d in enumerate(state["mesh"].devices.flat)
+        }
+
+    def destroy(self):
+        """Tear down the session (ref: Comms.destroy, comms.py:218)."""
+        _SESSIONS.pop(self.sessionId, None)
+        self.nccl_initialized = False
+        self.ucx_initialized = False
+
+    def __enter__(self):
+        return self.init()
+
+    def __exit__(self, *exc):
+        self.destroy()

@@ -1,0 +1,73 @@
+"""Handle-injection shims and collective self-test drivers.
+
+Ref: python/raft-dask/raft_dask/common/comms_utils.pyx —
+``inject_comms_on_handle``:288 / ``inject_comms_on_handle_coll_only``:258
+attach a bootstrapped communicator to a worker handle, and the
+``perform_test_comms_*`` wrappers drive the C++ self-tests in
+``raft::comms`` (comms/comms_test.hpp; exercised from
+raft_dask/test/test_comms.py:26-160). Here the self-tests run the
+:mod:`raft_tpu.comms.comms_test` suite over the handle's mesh.
+"""
+
+from __future__ import annotations
+
+from raft_tpu.comms import comms_test as _ct
+from raft_tpu.comms.comms import Comms as _RaftComms
+
+
+def inject_comms_on_handle(handle, comms, *args) -> None:
+    """Ref: comms_utils.pyx:288 (NCCL+UCX variant — p2p is implicit on
+    TPU)."""
+    handle.set_comms(comms)
+
+
+def inject_comms_on_handle_coll_only(handle, comms, *args) -> None:
+    """Ref: comms_utils.pyx:258 (collectives-only variant)."""
+    handle.set_comms(comms)
+
+
+def _mesh_axis(handle):
+    comms: _RaftComms = handle.get_comms()
+    axis = comms.axis if isinstance(comms.axis, str) else comms.axis[0]
+    return comms.mesh, axis
+
+
+def perform_test_comms_allreduce(handle) -> bool:
+    """Ref: comms_utils.pyx perform_test_comms_allreduce →
+    test_collective_allreduce."""
+    return _ct.test_collective_allreduce(*_mesh_axis(handle))
+
+
+def perform_test_comms_allgather(handle) -> bool:
+    return _ct.test_collective_allgather(*_mesh_axis(handle))
+
+
+def perform_test_comms_bcast(handle, root: int = 0) -> bool:
+    return _ct.test_collective_broadcast(*_mesh_axis(handle), root=root)
+
+
+def perform_test_comms_reduce(handle, root: int = 0) -> bool:
+    return _ct.test_collective_reduce(*_mesh_axis(handle), root=root)
+
+
+def perform_test_comms_reducescatter(handle) -> bool:
+    return _ct.test_collective_reducescatter(*_mesh_axis(handle))
+
+
+def perform_test_comms_send_recv(handle) -> bool:
+    return _ct.test_pointToPoint_simple_send_recv(*_mesh_axis(handle))
+
+
+def perform_test_comm_split(handle) -> bool:
+    """Ref: comms_utils.pyx perform_test_comm_split. The split test needs a
+    2-D topology (sub-communicator = sub-axis); refactor the session's
+    devices into a (rows, cols) mesh like comm_split's NCCL re-bootstrap
+    regroups ranks."""
+    import jax
+    import numpy as np
+
+    mesh, _ = _mesh_axis(handle)
+    devs = np.asarray(mesh.devices).reshape(-1)
+    rows = 2 if devs.size % 2 == 0 and devs.size >= 2 else 1
+    mesh2d = jax.sharding.Mesh(devs.reshape(rows, -1), ("rows", "cols"))
+    return _ct.test_commsplit(mesh2d)

@@ -17,14 +17,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.comms.comms import Comms, OpT
 
 
 def _run(mesh: Mesh, axis: str, fn, in_spec, out_spec, *args):
-    sm = shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                   check_rep=False)
+    sm = shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec)
     return sm(*args)
 
 
@@ -128,6 +127,6 @@ def test_commsplit(mesh2d: Mesh, row_axis: str = "rows",
         return sub.allreduce(jnp.ones((1, 1), jnp.float32))
 
     sm = shard_map(body, mesh=mesh2d, in_specs=(P(row_axis, col_axis),),
-                   out_specs=P(row_axis, col_axis), check_rep=False)
+                   out_specs=P(row_axis, col_axis))
     out = np.asarray(sm(jnp.zeros((nr, nc), jnp.float32)))
     return bool(np.all(out == nc))

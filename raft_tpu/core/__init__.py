@@ -32,6 +32,13 @@ from raft_tpu.core.serialize import (
 from raft_tpu.core.interruptible import Interruptible, synchronize
 from raft_tpu.core.logger import logger, set_level
 from raft_tpu.core.nvtx import range_scope, push_range, pop_range
+from raft_tpu.core import math
+from raft_tpu.core.temporary_buffer import (
+    TemporaryDeviceBuffer,
+    make_temporary_device_buffer,
+    make_readonly_temporary_device_buffer,
+    make_writeback_temporary_device_buffer,
+)
 
 __all__ = [
     "Resources",
@@ -61,4 +68,9 @@ __all__ = [
     "range_scope",
     "push_range",
     "pop_range",
+    "math",
+    "TemporaryDeviceBuffer",
+    "make_temporary_device_buffer",
+    "make_readonly_temporary_device_buffer",
+    "make_writeback_temporary_device_buffer",
 ]

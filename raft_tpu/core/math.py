@@ -1,0 +1,51 @@
+"""Scalar/elementwise math ops usable on host values and device arrays.
+
+Ref: cpp/include/raft/core/math.hpp — host/device-safe wrappers ``abs, acos,
+asin, atanh, cos, exp, log, max, min, pow, sgn, sin, sqrt, tanh`` that pick
+the right overload per dtype. On TPU the same role is played by ``jnp``
+ufuncs, which trace into XLA for arrays and degrade to NumPy scalars on the
+host; this module pins the reference's names (including variadic ``max`` /
+``min`` and the sign function ``sgn``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+abs = jnp.abs  # noqa: A001 - mirrors raft::abs
+acos = jnp.arccos
+asin = jnp.arcsin
+atanh = jnp.arctanh
+cos = jnp.cos
+exp = jnp.exp
+log = jnp.log
+pow = jnp.power  # noqa: A001 - mirrors raft::pow
+sin = jnp.sin
+sqrt = jnp.sqrt
+tanh = jnp.tanh
+
+
+def max(*args):  # noqa: A001 - mirrors raft::max
+    """Variadic elementwise maximum (ref: math.hpp raft::max)."""
+    if len(args) == 1:
+        return jnp.asarray(args[0])
+    out = jnp.maximum(args[0], args[1])
+    for a in args[2:]:
+        out = jnp.maximum(out, a)
+    return out
+
+
+def min(*args):  # noqa: A001 - mirrors raft::min
+    """Variadic elementwise minimum (ref: math.hpp raft::min)."""
+    if len(args) == 1:
+        return jnp.asarray(args[0])
+    out = jnp.minimum(args[0], args[1])
+    for a in args[2:]:
+        out = jnp.minimum(out, a)
+    return out
+
+
+def sgn(x):
+    """Sign function returning -1/0/+1 in the input dtype (ref: math.hpp
+    raft::sgn)."""
+    return jnp.sign(x)

@@ -1,0 +1,59 @@
+"""Scoped host↔device staging buffer.
+
+Ref: cpp/include/raft/core/temporary_device_buffer.hpp —
+``temporary_device_buffer`` wraps caller memory, exposes a device ``view()``
+and, for the writeback variant, copies the device contents back into the
+original host buffer when the scope ends (:109). The factory trio is
+``make_temporary_device_buffer`` / ``make_readonly_temporary_device_buffer``
+/ ``make_writeback_temporary_device_buffer`` (:152,196,239).
+
+TPU-native form: a context manager staging a NumPy buffer into HBM with
+``jax.device_put``; the writeback variant copies the (functionally updated)
+device value back into the original ndarray on exit. JAX arrays are
+immutable, so "writeback" means the user assigns ``buf.value`` inside the
+scope instead of mutating the view in place.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+
+class TemporaryDeviceBuffer:
+    """Device staging of a host ndarray, optionally written back on exit."""
+
+    def __init__(self, data: np.ndarray, writeback: bool = False,
+                 device: Optional[jax.Device] = None):
+        self._host = data
+        self._writeback = writeback
+        self.value = jax.device_put(data, device)
+
+    def view(self) -> jax.Array:
+        """The device-resident value (ref: temporary_device_buffer::view)."""
+        return self.value
+
+    def __enter__(self) -> "TemporaryDeviceBuffer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._writeback and exc[0] is None:
+            np.copyto(self._host, np.asarray(self.value))
+
+
+def make_temporary_device_buffer(data: np.ndarray) -> TemporaryDeviceBuffer:
+    """Read-write staging without writeback (ref: :152)."""
+    return TemporaryDeviceBuffer(data, writeback=False)
+
+
+def make_readonly_temporary_device_buffer(data: np.ndarray) -> TemporaryDeviceBuffer:
+    """Read-only staging (ref: :196)."""
+    return TemporaryDeviceBuffer(data, writeback=False)
+
+
+def make_writeback_temporary_device_buffer(data: np.ndarray) -> TemporaryDeviceBuffer:
+    """Staging whose final ``value`` is copied back to the host buffer on
+    scope exit (ref: :239)."""
+    return TemporaryDeviceBuffer(data, writeback=True)

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.core.error import expects
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
@@ -59,7 +59,6 @@ def sharded_kmeans_step(
         _em_body(axis, k), mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
         out_specs=(P(None, None), P()),
-        check_rep=False,
     )
     return fn(X, centroids)
 
@@ -78,7 +77,6 @@ def sharded_kmeans_fit(
         _em_body(axis, k), mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
         out_specs=(P(None, None), P()),
-        check_rep=False,
     )
     step = jax.jit(step)
     inertia = jnp.asarray(jnp.inf, X.dtype)

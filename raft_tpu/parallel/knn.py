@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.core.error import expects
 from raft_tpu.neighbors.brute_force import _tiled_knn_l2
@@ -66,6 +66,5 @@ def sharded_knn(
         local_search, mesh=mesh,
         in_specs=(P(axis, None), P(None, None)),
         out_specs=(P(None, None), P(None, None)),
-        check_rep=False,
     )
     return fn(db, queries)

@@ -1,0 +1,11 @@
+"""Legacy ``raft::spatial`` namespace.
+
+Ref: cpp/include/raft/spatial/knn/* — deprecated aliases kept for downstream
+consumers (cuML/cuGraph) that still spell the pre-``raft::neighbors`` paths
+(SURVEY.md §2.7 last row). Everything here forwards to
+:mod:`raft_tpu.neighbors`.
+"""
+
+from raft_tpu.spatial import knn
+
+__all__ = ["knn"]

@@ -1,0 +1,42 @@
+"""Legacy ``raft::spatial::knn`` aliases.
+
+Ref: cpp/include/raft/spatial/knn/{knn.cuh, ball_cover.cuh,
+epsilon_neighborhood.cuh, ann.cuh} — the deprecated pre-23.04 spellings of
+the neighbors APIs (``brute_force_knn``, ``knn_merge_parts``,
+``rbc_build_index`` / ``rbc_knn_query`` / ``rbc_all_knn_query``,
+``epsUnexpL2SqNeighborhood``, and the old quantized-ANN entry points that
+``ann_quantized.cuh:41-80`` maps onto ivf_flat/ivf_pq). Each name forwards
+to the modern :mod:`raft_tpu.neighbors` implementation, exactly as the
+reference's legacy headers forward to ``raft::neighbors``.
+"""
+
+from raft_tpu.neighbors.ball_cover import (
+    BallCoverIndex,
+    all_knn_query as rbc_all_knn_query,
+    build_index as rbc_build_index,
+    eps_nn as rbc_eps_nn,
+    knn_query as rbc_knn_query,
+)
+from raft_tpu.neighbors.brute_force import (
+    fused_l2_knn,
+    knn as brute_force_knn,
+    knn_merge_parts,
+)
+from raft_tpu.neighbors.epsilon_neighborhood import (
+    eps_neighbors_l2sq as epsUnexpL2SqNeighborhood,
+)
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+__all__ = [
+    "BallCoverIndex",
+    "rbc_all_knn_query",
+    "rbc_build_index",
+    "rbc_eps_nn",
+    "rbc_knn_query",
+    "fused_l2_knn",
+    "brute_force_knn",
+    "knn_merge_parts",
+    "epsUnexpL2SqNeighborhood",
+    "ivf_flat",
+    "ivf_pq",
+]

@@ -1,0 +1,24 @@
+"""shard_map across jax versions.
+
+jax >= 0.8 promotes ``shard_map`` to ``jax.shard_map`` and renames the
+replication-check flag ``check_rep`` → ``check_vma``; older versions ship it
+under ``jax.experimental.shard_map``. All raft_tpu call sites disable the
+check (collective-heavy bodies whose outputs are deliberately unreplicated),
+so this wrapper pins that behavior under whichever spelling exists.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+
+    _FLAG = "check_vma"
+except ImportError:  # pragma: no cover - jax < 0.8
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _FLAG = "check_rep"
+
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_FLAG: False})

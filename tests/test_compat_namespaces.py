@@ -1,0 +1,53 @@
+"""Legacy alias namespaces and core math/staging helpers.
+
+Covers raft_tpu.spatial.knn (ref: cpp/include/raft/spatial/knn deprecated
+aliases), raft_tpu.core.math (ref: core/math.hpp) and the temporary staging
+buffer (ref: core/temporary_device_buffer.hpp)."""
+
+import numpy as np
+
+import raft_tpu.core.math as rmath
+from raft_tpu.core import (
+    make_temporary_device_buffer,
+    make_writeback_temporary_device_buffer,
+)
+
+
+def test_spatial_knn_aliases(rng):
+    from raft_tpu import neighbors, spatial
+
+    assert spatial.knn.brute_force_knn is neighbors.brute_force.knn
+    assert spatial.knn.knn_merge_parts is neighbors.brute_force.knn_merge_parts
+    assert spatial.knn.rbc_build_index is neighbors.ball_cover.build_index
+    assert spatial.knn.ivf_pq is neighbors.ivf_pq
+
+    db = rng.normal(size=(64, 8)).astype(np.float32)
+    q = rng.normal(size=(4, 8)).astype(np.float32)
+    d, i = spatial.knn.brute_force_knn(db, q, k=3)
+    truth = np.argsort(((q[:, None] - db[None]) ** 2).sum(-1), axis=1)[:, :3]
+    np.testing.assert_array_equal(np.asarray(i), truth)
+
+
+def test_core_math(rng):
+    x = rng.normal(size=(16,)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(rmath.abs(x)), np.abs(x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(rmath.exp(x)), np.exp(x), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(rmath.sgn(x)), np.sign(x))
+    a, b, c = x[:5], x[5:10], x[10:15]
+    np.testing.assert_allclose(
+        np.asarray(rmath.max(a, b, c)), np.maximum(np.maximum(a, b), c)
+    )
+    np.testing.assert_allclose(
+        np.asarray(rmath.min(a, b, c)), np.minimum(np.minimum(a, b), c)
+    )
+
+
+def test_temporary_buffer_roundtrip():
+    host = np.arange(6, dtype=np.float32)
+    with make_temporary_device_buffer(host) as buf:
+        buf.value = buf.view() * 2
+    np.testing.assert_array_equal(host, np.arange(6, dtype=np.float32))
+
+    with make_writeback_temporary_device_buffer(host) as buf:
+        buf.value = buf.view() * 2
+    np.testing.assert_array_equal(host, 2 * np.arange(6, dtype=np.float32))

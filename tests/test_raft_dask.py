@@ -1,0 +1,61 @@
+"""raft_dask-compat session layer over the virtual CPU mesh.
+
+Mirrors python/raft-dask/raft_dask/test/test_comms.py:26-160, which drives
+the C++ collective self-tests from a LocalCUDACluster; the virtual 8-device
+CPU mesh plays the cluster's role (SURVEY.md §4)."""
+
+import pytest
+
+from raft_dask.common import (
+    Comms,
+    local_handle,
+    perform_test_comm_split,
+    perform_test_comms_allgather,
+    perform_test_comms_allreduce,
+    perform_test_comms_bcast,
+    perform_test_comms_reduce,
+    perform_test_comms_reducescatter,
+    perform_test_comms_send_recv,
+)
+
+
+@pytest.fixture
+def session():
+    c = Comms()
+    c.init()
+    yield c
+    c.destroy()
+
+
+def test_init_and_lookup(session):
+    handle = local_handle(session.sessionId)
+    assert handle is not None
+    assert handle.get_comms() is not None
+    info = session.worker_info()
+    assert len(info) == 8
+    assert sorted(v["rank"] for v in info.values()) == list(range(8))
+
+
+def test_destroy_clears_session():
+    c = Comms().init()
+    sid = c.sessionId
+    assert local_handle(sid) is not None
+    c.destroy()
+    assert local_handle(sid) is None
+    assert not c.nccl_initialized
+
+
+@pytest.mark.parametrize(
+    "fn",
+    [
+        perform_test_comms_allreduce,
+        perform_test_comms_allgather,
+        perform_test_comms_bcast,
+        perform_test_comms_reduce,
+        perform_test_comms_reducescatter,
+        perform_test_comms_send_recv,
+        perform_test_comm_split,
+    ],
+)
+def test_collectives(session, fn):
+    assert fn(local_handle(session.sessionId))
