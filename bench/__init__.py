@@ -1,0 +1,4 @@
+"""Microbenchmark suite, the analog of the reference's gbench binaries
+(cpp/bench: CLUSTER_BENCH, DISTANCE_BENCH, LINALG_BENCH, MATRIX_BENCH,
+NEIGHBORS_BENCH, RANDOM_BENCH; SURVEY.md §6). Run ``python -m bench`` or
+``python -m bench distance matrix --quick``."""

@@ -1,0 +1,180 @@
+"""gbench-analog microbenchmarks (see bench/__init__.py).
+
+Shapes follow the reference's gbench parameterizations where practical
+(cpp/bench/{distance,matrix,cluster,neighbors,random}/*.cu); ``--quick``
+shrinks everything for CI smoke runs on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+from bench.common import report, scan_time, wall_time
+
+R = 8  # iteration batches per scan measurement
+
+
+def _data(rng, *shape):
+    return rng.normal(size=shape).astype(np.float32)
+
+
+def bench_distance(rng, quick: bool):
+    import jax.numpy as jnp
+
+    from raft_tpu.distance import fused_l2_nn as fnn
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.distance.pairwise import distance as pairwise
+
+    m, n, d = (256, 256, 32) if quick else (2048, 2048, 128)
+    y = jnp.asarray(_data(rng, n, d))
+    xs = jnp.asarray(_data(rng, R, m, d))
+    for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded,
+                   DistanceType.L1):
+        sec = scan_time(lambda x, y: pairwise(x, y, metric=metric), xs, (y,))
+        report("distance", f"pairwise_{metric.name}", sec, m * n,
+               unit="pairs/s", m=m, n=n, d=d)
+
+    # fused L2 argmin (the kmeans inner loop; ref cpp/bench/distance/fused_l2_nn.cu)
+    mm, nn, dd = (512, 64, 16) if quick else (8192, 1024, 64)
+    ys = jnp.asarray(_data(rng, nn, dd))
+    xss = jnp.asarray(_data(rng, R, mm, dd))
+    sec = scan_time(lambda x, y: fnn.fused_l2_nn_min_reduce(x, y), xss, (ys,))
+    report("distance", "fused_l2_nn", sec, mm, unit="rows/s", m=mm, n=nn, d=dd)
+
+
+def bench_linalg(rng, quick: bool):
+    import jax.numpy as jnp
+
+    from raft_tpu.linalg.norm import row_norm
+    from raft_tpu.linalg.reduce import coalesced_reduction
+    from raft_tpu.linalg.matrix_vector import matrix_vector_op
+
+    m, n = (512, 128) if quick else (8192, 1024)
+    xs = jnp.asarray(_data(rng, R, m, n))
+    v = jnp.asarray(_data(rng, n))
+    sec = scan_time(lambda x: coalesced_reduction(x), xs)
+    report("linalg", "coalesced_reduction", sec, m * n, unit="elems/s", m=m, n=n)
+    sec = scan_time(lambda x: row_norm(x), xs)
+    report("linalg", "row_norm_l2", sec, m * n, unit="elems/s", m=m, n=n)
+    sec = scan_time(lambda x, v: matrix_vector_op(x, v, jnp.add), xs, (v,))
+    report("linalg", "matrix_vector_op", sec, m * n, unit="elems/s", m=m, n=n)
+
+
+def bench_matrix(rng, quick: bool):
+    import jax.numpy as jnp
+
+    from raft_tpu.matrix.select_k import SelectMethod, select_k
+
+    # warpsort regime (ref cpp/bench/matrix/select_k.cu small-len cases)
+    b, l, k = (64, 1024, 10) if quick else (1000, 10000, 10)
+    xs = jnp.asarray(_data(rng, R, b, l))
+    sec = scan_time(lambda x: select_k(x, k), xs)
+    report("matrix", "select_k_small", sec, b, unit="rows/s", batch=b, len=l, k=k)
+
+    # radix regime: batch>=64, len>=102400, k>=128 (select_k.cuh:81)
+    b, l, k = (16, 8192, 32) if quick else (64, 131072, 128)
+    xs = jnp.asarray(_data(rng, R, b, l))
+    for method in (SelectMethod.kTopK, SelectMethod.kTwoPhase):
+        sec = scan_time(lambda x: select_k(x, k, method=method), xs)
+        report("matrix", f"select_k_large_{method.name}", sec, b,
+               unit="rows/s", batch=b, len=l, k=k)
+
+
+def bench_random(rng, quick: bool):
+    from raft_tpu.random.make_blobs import make_blobs
+    from raft_tpu.random.rng import permute
+    from raft_tpu.random.rng_state import RngState
+
+    n, d = (4096, 16) if quick else (100_000, 64)
+    sec = wall_time(lambda: make_blobs(n, d, n_clusters=16, seed=1))
+    report("random", "make_blobs", sec, n, unit="rows/s", rows=n, cols=d)
+
+    np_ = 1 << 14 if quick else 1 << 20
+    sec = wall_time(lambda: permute(RngState(0), np_))
+    report("random", "permute", sec, np_, unit="elems/s", n=np_)
+
+
+def bench_cluster(rng, quick: bool):
+    from raft_tpu.cluster import kmeans, kmeans_balanced
+    from raft_tpu.cluster.kmeans_types import KMeansBalancedParams, KMeansParams
+
+    n, d, kk = (4096, 16, 16) if quick else (50_000, 64, 256)
+    X = _data(rng, n, d)
+    params = KMeansParams(n_clusters=kk, max_iter=10)
+    sec = wall_time(lambda: kmeans.fit(params, X)[0], repeats=1)
+    report("cluster", "kmeans_fit", sec, n * 10, unit="rows·iter/s",
+           rows=n, dim=d, k=kk)
+
+    n, d, kk = (8192, 16, 64) if quick else (100_000, 64, 512)
+    Xb = _data(rng, n, d)
+    bparams = KMeansBalancedParams(n_iters=10)
+    sec = wall_time(lambda: kmeans_balanced.fit(bparams, Xb, kk), repeats=1)
+    report("cluster", "kmeans_balanced_fit", sec, n * 10, unit="rows·iter/s",
+           rows=n, dim=d, k=kk)
+
+
+def bench_neighbors(rng, quick: bool):
+    import jax.numpy as jnp
+
+    from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+    n, d, q, k = (8192, 32, 256, 10) if quick else (100_000, 128, 1000, 10)
+    db = jnp.asarray(_data(rng, n, d))
+    qs = jnp.asarray(_data(rng, R, q, d))
+    sec = scan_time(lambda x, db: brute_force.knn(db, x, k), qs, (db,))
+    report("neighbors", "brute_force_knn", sec, q, unit="qps",
+           n_db=n, dim=d, n_queries=q, k=k)
+
+    # IVF-Flat (ref cpp/bench/neighbors/knn.cuh params)
+    n_lists, n_probes = (16, 4) if quick else (256, 32)
+    ip = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=5)
+    sec = wall_time(lambda: ivf_flat.build(ip, db), repeats=1)
+    report("neighbors", "ivf_flat_build", sec, n, unit="rows/s",
+           n_db=n, dim=d, n_lists=n_lists)
+    idx = ivf_flat.build(ip, db)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    sec = scan_time(lambda x: ivf_flat.search(sp, idx, x, k), qs)
+    report("neighbors", "ivf_flat_search", sec, q, unit="qps",
+           n_db=n, dim=d, n_probes=n_probes, k=k)
+
+    # IVF-PQ
+    pp = ivf_pq.IndexParams(n_lists=n_lists, kmeans_n_iters=5)
+    sec = wall_time(lambda: ivf_pq.build(pp, db), repeats=1)
+    report("neighbors", "ivf_pq_build", sec, n, unit="rows/s",
+           n_db=n, dim=d, n_lists=n_lists)
+    pidx = ivf_pq.build(pp, db)
+    psp = ivf_pq.SearchParams(n_probes=n_probes)
+    sec = scan_time(lambda x: ivf_pq.search(psp, pidx, x, k), qs)
+    report("neighbors", "ivf_pq_search", sec, q, unit="qps",
+           n_db=n, dim=d, n_probes=n_probes, k=k)
+
+
+FAMILIES = {
+    "distance": bench_distance,
+    "linalg": bench_linalg,
+    "matrix": bench_matrix,
+    "random": bench_random,
+    "cluster": bench_cluster,
+    "neighbors": bench_neighbors,
+}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("families", nargs="*",
+                    help=f"bench families (default all): {list(FAMILIES)}")
+    ap.add_argument("--quick", action="store_true",
+                    help="tiny shapes (CI smoke; CPU-friendly)")
+    args = ap.parse_args(argv)
+    unknown = set(args.families) - set(FAMILIES)
+    if unknown:
+        ap.error(f"unknown families {sorted(unknown)}; pick from {list(FAMILIES)}")
+    rng = np.random.default_rng(42)
+    for fam in (args.families or list(FAMILIES)):
+        FAMILIES[fam](rng, args.quick)
+
+
+if __name__ == "__main__":
+    main()

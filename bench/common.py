@@ -1,0 +1,78 @@
+"""Timing harness.
+
+Ref: cpp/bench/common/benchmark.hpp:93-148 — the reference times with
+cudaEvents and flushes L2 between iterations. The TPU device link (axon
+tunnel) adds ~100 ms per synchronized call, so steady-state per-iteration
+time is measured by scanning the op over R distinct input batches *inside
+one jit* (lax.scan) and syncing once via a scalar checksum transfer; the
+link overhead amortizes over R. The distinct batches prevent XLA from
+hoisting the body out of the loop; the checksum keeps it from dead-code
+elimination — the same roles the L2 flush and result consumption play in
+the reference fixture.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _checksum(out) -> jax.Array:
+    s = jnp.float32(0)
+    for leaf in jax.tree_util.tree_leaves(out):
+        s = s + jnp.sum(leaf.astype(jnp.float32))
+    return s
+
+
+def scan_time(fn: Callable, xs, extra: Sequence = (), repeats: int = 3) -> float:
+    """Seconds per application of ``fn(x, *extra)``, with ``xs`` a pytree
+    whose leaves carry a leading iteration axis R."""
+    R = jax.tree_util.tree_leaves(xs)[0].shape[0]
+
+    @jax.jit
+    def run(xs, *extra):
+        def body(acc, x):
+            return acc + _checksum(fn(x, *extra)), None
+
+        acc, _ = lax.scan(body, jnp.float32(0), xs)
+        return acc
+
+    np.asarray(run(xs, *extra))  # compile + warm
+    best = np.inf
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(run(xs, *extra))
+        best = min(best, (time.perf_counter() - t0) / R)
+    return best
+
+
+def wall_time(fn: Callable, repeats: int = 2) -> float:
+    """Wall-clock seconds for host-driving functions (index builds, fits)
+    that cannot scan; first call (compile) excluded."""
+    jax.block_until_ready(fn())
+    best = np.inf
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def report(family: str, name: str, seconds: float, items: float = 0.0,
+           unit: str = "items/s", **params) -> dict:
+    rec = {
+        "family": family,
+        "bench": name,
+        "ms": round(seconds * 1e3, 4),
+        **({"throughput": round(items / seconds, 1), "unit": unit}
+           if items else {}),
+        "params": params,
+    }
+    print(json.dumps(rec), flush=True)
+    return rec

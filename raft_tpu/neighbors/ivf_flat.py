@@ -93,6 +93,11 @@ class Index:
         return self.centers.shape[1]
 
     @property
+    def capacity(self) -> int:
+        """Static total slot capacity (n_lists * per-list cap)."""
+        return self.indices.shape[0] * self.indices.shape[1]
+
+    @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
@@ -290,7 +295,11 @@ def search(
     Q = _as_float(queries)
     expects(Q.ndim == 2 and Q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(params.n_probes, index.n_lists)
-    k = min(k, max(index.size, 1))
+    # Clamp by static capacity so search stays traceable (jit/scan over
+    # query batches); below-capacity emptiness is handled by the per-slot
+    # validity mask in _probe_scan (inf distance / -1 id), matching the
+    # reference's fewer-than-k semantics.
+    k = min(k, max(index.capacity, 1))
 
     metric = index.metric
     inner_is_l2 = metric != DistanceType.InnerProduct

@@ -133,6 +133,11 @@ class Index:
         return 1 << self.pq_bits
 
     @property
+    def capacity(self) -> int:
+        """Static total slot capacity (n_lists * per-list cap)."""
+        return self.indices.shape[0] * self.indices.shape[1]
+
+    @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
@@ -478,7 +483,9 @@ def search(
     Q = _as_float(queries)
     expects(Q.ndim == 2 and Q.shape[1] == index.dim, "query dim mismatch")
     n_probes = min(params.n_probes, index.n_lists)
-    k = min(k, max(index.size, 1))
+    # Static capacity clamp keeps search traceable (jit/scan over query
+    # batches); empty slots are masked inside _pq_probe_scan.
+    k = min(k, max(index.capacity, 1))
     is_ip = index.metric == DistanceType.InnerProduct
 
     probe_ids = _select_clusters((Q, index.centers), n_probes, is_ip)

@@ -1,0 +1,17 @@
+"""Smoke-run the gbench-analog suite in quick mode (one family) so the
+bench harness can't rot (the reference builds its gbench binaries in CI,
+cpp/bench/CMakeLists.txt)."""
+
+import json
+
+
+def test_bench_quick_smoke(capsys):
+    from bench.__main__ import main
+
+    main(["matrix", "--quick"])
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) == 3
+    for line in lines:
+        rec = json.loads(line)
+        assert rec["family"] == "matrix"
+        assert rec["ms"] > 0
