@@ -25,9 +25,11 @@ import time
 import numpy as np
 
 
-def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0, n_sets=8):
+def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0, n_sets=256):
     """SIFT-10K-shaped synthetic data (uint8-range descriptors); n_sets
-    distinct query batches so repeated iterations cannot be cached."""
+    distinct query batches so repeated iterations cannot be cached or
+    hoisted out of the scan. n_sets=256 amortizes the ~100 ms axon-link
+    round-trip to <0.4 ms/iteration."""
     rng = np.random.default_rng(seed)
     db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
     qs = rng.integers(0, 256, size=(n_sets, n_q, dim)).astype(np.float32)
@@ -68,19 +70,22 @@ def main():
     def run_all(qs, db):
         def body(acc, q):
             d, i = brute_force.knn(db, q, k)
-            return acc + d[0, 0] + i[0, 0].astype(jnp.float32), (d, i)
-        acc, (ds, is_) = lax.scan(body, jnp.float32(0), qs)
-        return acc, ds, is_
+            return acc + d[0, 0] + i[0, 0].astype(jnp.float32), None
+        acc, _ = lax.scan(body, jnp.float32(0), qs)
+        # Keep only the first batch's full results (correctness gate) — at
+        # n_sets=256, stacking every (d, i) would carry 256× dead outputs.
+        d0, i0 = brute_force.knn(db, qs[0], k)
+        return acc, d0, i0
 
     # Warmup (compile) + one synced run, then timed runs (sync via host
     # transfer of the checksum scalar).
-    acc, ds, is_ = run_all(qs, db)
+    acc, d0, i0 = run_all(qs, db)
     np.asarray(acc)
     R = qs.shape[0]
     best = np.inf
-    for _ in range(3):
+    for _ in range(4):
         t0 = time.perf_counter()
-        acc, ds, is_ = run_all(qs, db)
+        acc, d0, i0 = run_all(qs, db)
         np.asarray(acc)
         best = min(best, (time.perf_counter() - t0) / R)
     qps = qs.shape[1] / best
@@ -91,7 +96,7 @@ def main():
     dn = ((q0 * q0).sum(1)[:, None] + (db_h * db_h).sum(1)[None, :]
           - 2.0 * q0 @ db_h.T)
     truth = np.argsort(dn, axis=1)[:, :k]
-    found = np.asarray(is_)[0]
+    found = np.asarray(i0)
     hits = sum(len(np.intersect1d(found[r], truth[r]))
                for r in range(q0.shape[0]))
     recall = hits / truth.size
