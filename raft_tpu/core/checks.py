@@ -1,0 +1,64 @@
+"""Opt-in runtime value checking — the sanitizer layer.
+
+Ref: SURVEY.md §5 "race detection/sanitizers": the reference has no built-in
+sanitizer; it leans on defensive ``RAFT_EXPECTS`` host-side precondition
+macros (core/error.hpp:168) and documents a thread-safety contract. The TPU
+build's concurrency safety comes from jit purity (no data races by
+construction), so the analogous *runtime* hazard is numeric: NaN/Inf
+escaping a kernel, out-of-range indices feeding a gather.
+
+This module provides that missing layer: ``checked(fn)`` wraps a jittable
+function with ``jax.experimental.checkify`` (float + index + div checks) so
+traced errors surface as Python exceptions, and ``debug_nan_guard`` flips
+JAX's global ``jax_debug_nans`` the way compute-sanitizer would be toggled
+on a CUDA run.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+from typing import Callable
+
+import jax
+from jax.experimental import checkify
+
+
+def checked(fn: Callable, errors=None) -> Callable:
+    """Wrap ``fn`` so checkify errors raise on the host.
+
+    ``errors`` defaults to float (NaN/Inf), index OOB, and division checks —
+    the traced-code analog of RAFT_EXPECTS preconditions. The wrapped
+    function stays jittable (checkify functionalizes the assertions).
+    """
+    if errors is None:
+        errors = (checkify.float_checks | checkify.index_checks
+                  | checkify.div_checks)
+    cfn = checkify.checkify(fn, errors=errors)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        err, out = cfn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper
+
+
+@contextlib.contextmanager
+def debug_nan_guard(enable: bool = True):
+    """Scope with ``jax_debug_nans`` toggled — the compute-sanitizer-style
+    big hammer: every primitive re-runs eagerly when a NaN appears."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", enable)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check(pred, msg: str, **fmt) -> None:
+    """Traced-side assertion (ref: RAFT_EXPECTS inside kernels — device-side
+    ``assert()`` is a trap on CUDA; here it is a functionalized check that
+    surfaces through :func:`checked`)."""
+    checkify.check(pred, msg, **fmt)

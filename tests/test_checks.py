@@ -1,0 +1,36 @@
+"""Sanitizer layer (core/checks.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core.checks import check, checked
+
+
+def test_checked_passes_clean_fn():
+    fn = checked(lambda x: jnp.sqrt(x) + 1.0)
+    out = fn(jnp.asarray([1.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(out), [2.0, 3.0])
+
+
+def test_checked_raises_on_nan():
+    fn = checked(lambda x: jnp.log(x))  # log(-1) = nan
+    with pytest.raises(Exception, match="nan"):
+        fn(jnp.asarray([-1.0]))
+
+
+def test_checked_raises_on_oob_index():
+    fn = checked(lambda x, i: x[i])
+    with pytest.raises(Exception):
+        fn(jnp.arange(4.0), jnp.asarray(10))
+
+
+def test_explicit_check_surfaces():
+    @checked
+    def fn(x):
+        check(jnp.all(x > 0), "x must be positive")
+        return x * 2.0
+
+    fn(jnp.asarray([1.0]))
+    with pytest.raises(Exception, match="positive"):
+        fn(jnp.asarray([-3.0]))
