@@ -33,7 +33,7 @@ def checked(fn: Callable, errors=None) -> Callable:
     """
     if errors is None:
         errors = (checkify.float_checks | checkify.index_checks
-                  | checkify.div_checks)
+                  | checkify.div_checks | checkify.user_checks)
     cfn = checkify.checkify(fn, errors=errors)
 
     @functools.wraps(fn)
