@@ -12,10 +12,11 @@ measured in-process — the honest available baseline on this hardware.
 Timing methodology: the device link (axon tunnel) has ~100 ms round-trip
 latency per synchronized call and ``block_until_ready`` does not reliably
 fence it, so the workload is iterated R times *inside one jit* via
-``lax.scan`` over R distinct query batches and synced once with a host
-transfer. Per-iteration time = total / R with the link overhead amortized
-(the analog of the reference's cudaEvent timing with L2-flush between
-iterations, cpp/bench/common/benchmark.hpp:93-148).
+``lax.scan``, with the query batch perturbed by the scan index so XLA can
+neither hoist nor cache the body, and synced once with a host transfer.
+Per-iteration time = total / R with the link overhead amortized (the analog
+of the reference's cudaEvent timing with L2-flush between iterations,
+cpp/bench/common/benchmark.hpp:93-148).
 """
 
 import json
@@ -25,15 +26,12 @@ import time
 import numpy as np
 
 
-def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0, n_sets=256):
-    """SIFT-10K-shaped synthetic data (uint8-range descriptors); n_sets
-    distinct query batches so repeated iterations cannot be cached or
-    hoisted out of the scan. n_sets=256 amortizes the ~100 ms axon-link
-    round-trip to <0.4 ms/iteration."""
+def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
+    """SIFT-10K-shaped synthetic data (uint8-range descriptors)."""
     rng = np.random.default_rng(seed)
     db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
-    qs = rng.integers(0, 256, size=(n_sets, n_q, dim)).astype(np.float32)
-    return db, qs
+    q = rng.integers(0, 256, size=(n_q, dim)).astype(np.float32)
+    return db, q
 
 
 def _numpy_knn_qps(db, q, k, reps=3):
@@ -62,43 +60,44 @@ def main():
     from raft_tpu.neighbors import brute_force
 
     k = 10
-    db_h, qs_h = _sift_like()
+    R = 512  # iterations per synchronized run: amortizes the ~100 ms
+    # axon-link round-trip to ~0.2 ms/iteration
+    db_h, q_h = _sift_like()
     db = jax.device_put(db_h)
-    qs = jax.device_put(qs_h)
+    q0 = jax.device_put(q_h)
 
     @jax.jit
-    def run_all(qs, db):
-        def body(acc, q):
-            d, i = brute_force.knn(db, q, k)
-            return acc + d[0, 0] + i[0, 0].astype(jnp.float32), None
-        acc, _ = lax.scan(body, jnp.float32(0), qs)
-        # Keep only the first batch's full results (correctness gate) — at
-        # n_sets=256, stacking every (d, i) would carry 256× dead outputs.
-        d0, i0 = brute_force.knn(db, qs[0], k)
+    def run_all(q0, db):
+        # Perturb the query batch per step (anti-hoisting: the body must
+        # depend on the scan index) — the timing analog of the reference's
+        # L2-flush between iterations (cpp/bench/common/benchmark.hpp).
+        def body(acc, i):
+            d, idx = brute_force.knn(db, q0 + i * jnp.float32(1e-4), k)
+            return acc + d[0, 0] + idx[0, 0].astype(jnp.float32), None
+        acc, _ = lax.scan(body, jnp.float32(0),
+                          jnp.arange(R, dtype=jnp.float32))
+        d0, i0 = brute_force.knn(db, q0, k)  # unperturbed: correctness gate
         return acc, d0, i0
 
     # Warmup (compile) + one synced run, then timed runs (sync via host
     # transfer of the checksum scalar).
-    acc, d0, i0 = run_all(qs, db)
+    acc, d0, i0 = run_all(q0, db)
     np.asarray(acc)
-    R = qs.shape[0]
     best = np.inf
     for _ in range(4):
         t0 = time.perf_counter()
-        acc, d0, i0 = run_all(qs, db)
+        acc, d0, i0 = run_all(q0, db)
         np.asarray(acc)
         best = min(best, (time.perf_counter() - t0) / R)
-    qps = qs.shape[1] / best
+    qps = q_h.shape[0] / best
 
-    # Correctness gate: recall@10 == 1.0 vs exact NumPy ground truth on the
-    # first query batch.
-    q0 = qs_h[0]
-    dn = ((q0 * q0).sum(1)[:, None] + (db_h * db_h).sum(1)[None, :]
-          - 2.0 * q0 @ db_h.T)
+    # Correctness gate: recall@10 == 1.0 vs exact NumPy ground truth.
+    dn = ((q_h * q_h).sum(1)[:, None] + (db_h * db_h).sum(1)[None, :]
+          - 2.0 * q_h @ db_h.T)
     truth = np.argsort(dn, axis=1)[:, :k]
     found = np.asarray(i0)
     hits = sum(len(np.intersect1d(found[r], truth[r]))
-               for r in range(q0.shape[0]))
+               for r in range(q_h.shape[0]))
     recall = hits / truth.size
     if recall < 0.999:
         print(json.dumps({"metric": "bf_knn_sift10k_qps", "value": 0.0,
@@ -106,7 +105,7 @@ def main():
                           "error": f"recall {recall:.4f} < 1.0"}))
         sys.exit(1)
 
-    cpu_qps = _numpy_knn_qps(db_h, q0, k)
+    cpu_qps = _numpy_knn_qps(db_h, q_h, k)
     print(json.dumps({
         "metric": "bf_knn_sift10k_qps",
         "value": round(qps, 1),

@@ -13,17 +13,6 @@ import numpy as np
 
 from bench.common import report, scan_time, wall_time
 
-# Iteration batches per scan measurement: enough to amortize the ~100 ms
-# device-link round-trip per synchronized run (bench/common.py), capped so
-# the stacked input stays within a memory budget.
-_R_BYTES_BUDGET = 256 * 1024 * 1024
-
-
-def _n_sets(*shape) -> int:
-    bytes_per_set = 4 * int(np.prod(shape))
-    return int(max(8, min(128, _R_BYTES_BUDGET // max(bytes_per_set, 1))))
-
-
 def _data(rng, *shape):
     return rng.normal(size=shape).astype(np.float32)
 
@@ -37,7 +26,7 @@ def bench_distance(rng, quick: bool):
 
     m, n, d = (256, 256, 32) if quick else (2048, 2048, 128)
     y = jnp.asarray(_data(rng, n, d))
-    xs = jnp.asarray(_data(rng, _n_sets(m, d), m, d))
+    xs = jnp.asarray(_data(rng, m, d))
     for metric in (DistanceType.L2Expanded, DistanceType.CosineExpanded,
                    DistanceType.L1):
         sec = scan_time(lambda x, y: pairwise(x, y, metric=metric), xs, (y,))
@@ -47,7 +36,7 @@ def bench_distance(rng, quick: bool):
     # fused L2 argmin (the kmeans inner loop; ref cpp/bench/distance/fused_l2_nn.cu)
     mm, nn, dd = (512, 64, 16) if quick else (8192, 1024, 64)
     ys = jnp.asarray(_data(rng, nn, dd))
-    xss = jnp.asarray(_data(rng, _n_sets(mm, dd), mm, dd))
+    xss = jnp.asarray(_data(rng, mm, dd))
     sec = scan_time(lambda x, y: fnn.fused_l2_nn_min_reduce(x, y), xss, (ys,))
     report("distance", "fused_l2_nn", sec, mm, unit="rows/s", m=mm, n=nn, d=dd)
 
@@ -60,7 +49,7 @@ def bench_linalg(rng, quick: bool):
     from raft_tpu.linalg.matrix_vector import matrix_vector_op
 
     m, n = (512, 128) if quick else (8192, 1024)
-    xs = jnp.asarray(_data(rng, _n_sets(m, n), m, n))
+    xs = jnp.asarray(_data(rng, m, n))
     v = jnp.asarray(_data(rng, n))
     sec = scan_time(lambda x: coalesced_reduction(x), xs)
     report("linalg", "coalesced_reduction", sec, m * n, unit="elems/s", m=m, n=n)
@@ -77,13 +66,13 @@ def bench_matrix(rng, quick: bool):
 
     # warpsort regime (ref cpp/bench/matrix/select_k.cu small-len cases)
     b, l, k = (64, 1024, 10) if quick else (1000, 10000, 10)
-    xs = jnp.asarray(_data(rng, _n_sets(b, l), b, l))
+    xs = jnp.asarray(_data(rng, b, l))
     sec = scan_time(lambda x: select_k(x, k), xs)
     report("matrix", "select_k_small", sec, b, unit="rows/s", batch=b, len=l, k=k)
 
     # radix regime: batch>=64, len>=102400, k>=128 (select_k.cuh:81)
     b, l, k = (16, 8192, 32) if quick else (64, 131072, 128)
-    xs = jnp.asarray(_data(rng, _n_sets(b, l), b, l))
+    xs = jnp.asarray(_data(rng, b, l))
     for method in (SelectMethod.kTopK, SelectMethod.kTwoPhase):
         sec = scan_time(lambda x: select_k(x, k, method=method), xs)
         report("matrix", f"select_k_large_{method.name}", sec, b,
@@ -130,7 +119,7 @@ def bench_neighbors(rng, quick: bool):
 
     n, d, q, k = (8192, 32, 256, 10) if quick else (100_000, 128, 1000, 10)
     db = jnp.asarray(_data(rng, n, d))
-    qs = jnp.asarray(_data(rng, _n_sets(q, d), q, d))
+    qs = jnp.asarray(_data(rng, q, d))
     sec = scan_time(lambda x, db: brute_force.knn(db, x, k), qs, (db,))
     report("neighbors", "brute_force_knn", sec, q, unit="qps",
            n_db=n, dim=d, n_queries=q, k=k)

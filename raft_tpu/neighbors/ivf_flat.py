@@ -62,9 +62,28 @@ class IndexParams:
 
 @dataclass
 class SearchParams:
-    """Ref: ivf_flat::search_params (neighbors/ivf_flat_types.hpp:74-78)."""
+    """Ref: ivf_flat::search_params (neighbors/ivf_flat_types.hpp:74-78).
+
+    TPU extension fields (not in the reference struct, which tunes the
+    analogous decomposition inside the kernel launch instead):
+
+    ``engine``: "auto" | "scan" | "bucketed". "scan" is the per-query
+    gather path (exact probe coverage). "bucketed" inverts the probe map —
+    per list, the queries probing it are batched and scored with one MXU
+    matmul (the query-grouping of calc_chunk_indices,
+    detail/ivf_pq_search.cuh:267, turned into dense tiles). Lists probed by
+    more than ``bucket_cap`` queries drop the *farthest-rank* probes of the
+    excess queries — bounded, documented approximation on top of an already
+    approximate index. "auto" picks bucketed on TPU when the probe load
+    q·n_probes/n_lists is high enough to fill tiles.
+
+    ``bucket_cap``: per-list query-slot capacity for "bucketed"; 0 = auto
+    (4× the mean probe load, rounded up to 8).
+    """
 
     n_probes: int = 20
+    engine: str = "auto"
+    bucket_cap: int = 0
 
 
 @dataclass
@@ -284,6 +303,80 @@ def _probe_scan(
     return best_d, best_i
 
 
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+def _bucketed_probe_scan(
+    queries, data, indices, list_sizes, probe_ids,
+    k: int, inner_is_l2: bool, sqrt: bool, bucket_cap: int,
+    interpret: bool = False,
+):
+    """Probe scan with the probe map inverted to per-list query buckets.
+
+    Ref: the reference groups (query, probe) work by cluster via
+    calc_chunk_indices (detail/ivf_pq_search.cuh:267) so each block scans
+    one list for a chunk of queries. TPU re-tiling of the same idea: a
+    stable sort of the flattened (probe_rank-major) pairs by list id yields,
+    per list, the queries probing it ordered best-rank-first; the first
+    ``bucket_cap`` fill a dense (n_lists, bucket_cap) bucket table. One
+    batched Pallas fused-kNN launch then scores every bucket against its
+    own list as a real (bucket_cap, d)×(d, cap) MXU matmul — instead of the
+    scan path's per-query row gather + batched matvec — and each pair's
+    top-k is routed back through the sort permutation for the final
+    per-query merge (select_k over n_probes·k candidates).
+    """
+    from raft_tpu.ops.fused_knn import fused_batch_knn
+
+    q, d = queries.shape
+    n_lists, cap, _ = data.shape
+    p = probe_ids.shape[1]
+
+    # --- invert: (query → lists) to (list → queries), rank-major so that
+    # bucket overflow drops the farthest-centroid probes first.
+    flat_lists = probe_ids.T.reshape(-1)                       # (p·q,)
+    flat_query = jnp.tile(jnp.arange(q, dtype=jnp.int32), p)
+    order = jnp.argsort(flat_lists, stable=True)
+    sorted_lists = flat_lists[order].astype(jnp.int32)
+    sorted_query = flat_query[order]
+    starts = jnp.searchsorted(sorted_lists,
+                              jnp.arange(n_lists, dtype=jnp.int32))
+    pos = jnp.arange(q * p, dtype=jnp.int32) - starts[sorted_lists]
+    keep = pos < bucket_cap
+    slot = jnp.where(keep, sorted_lists * bucket_cap + pos,
+                     n_lists * bucket_cap)                     # OOB → drop
+    bucket = (jnp.full((n_lists * bucket_cap,), -1, jnp.int32)
+              .at[slot].set(sorted_query, mode="drop")
+              .reshape(n_lists, bucket_cap))
+
+    # --- batched per-list kNN on the MXU
+    qsel = jnp.maximum(bucket, 0)
+    Qb = queries[qsel]                                         # (L, cap_q, d)
+    invalid = jnp.arange(cap, dtype=jnp.int32)[None, :] >= list_sizes[:, None]
+    bd_, bi_ = fused_batch_knn(
+        Qb, data, invalid, k,
+        metric="l2" if inner_is_l2 else "ip", interpret=interpret)
+    kk = bd_.shape[2]                                          # min(k, cap)
+    gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
+                 jnp.maximum(bi_, 0)]                          # (L, cap_q, kk)
+    worst = jnp.inf if inner_is_l2 else -jnp.inf
+    gi = jnp.where(bi_ < 0, -1, gi)
+
+    # --- route each pair's candidates back to its query
+    ppos = jnp.minimum(pos, bucket_cap - 1)
+    cd = bd_[sorted_lists, ppos]                               # (p·q, kk)
+    ci = gi[sorted_lists, ppos]
+    cd = jnp.where(keep[:, None], cd, worst)
+    ci = jnp.where(keep[:, None], ci, -1)
+    inv = jnp.argsort(order)
+    cd = cd[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
+    ci = ci[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
+
+    # indices= payload: select_k then maps its k>n padding slots to the -1
+    # sentinel instead of emitting out-of-range positions.
+    best_d, best_i = select_k(cd, k, select_min=inner_is_l2, indices=ci)
+    if inner_is_l2 and sqrt:
+        best_d = jnp.sqrt(best_d)
+    return best_d, best_i
+
+
 def search(
     params: SearchParams, index: Index, queries, k: int,
     handle=None,
@@ -318,6 +411,27 @@ def search(
         _, probe_ids = select_k(cd, n_probes, select_min=False)
 
     dataf = _as_float(index.data)
+
+    engine = params.engine
+    expects(engine in ("auto", "scan", "bucketed"),
+            f"unknown engine {params.engine!r} (auto|scan|bucketed)")
+    if engine == "auto":
+        # Bucketed wins when the mean probe load per list fills MXU tiles;
+        # tiny loads leave the batched kernel mostly padding.
+        load = Q.shape[0] * n_probes / index.n_lists
+        engine = ("bucketed"
+                  if jax.default_backend() == "tpu" and load >= 32 and k <= 128
+                  else "scan")
+    if engine == "bucketed":
+        cap_q = params.bucket_cap
+        if cap_q == 0:
+            mean_load = max(1, (Q.shape[0] * n_probes) // index.n_lists)
+            cap_q = min(Q.shape[0], 8 * ceildiv(4 * mean_load, 8))
+        return _bucketed_probe_scan(
+            Q, dataf, index.indices, index.list_sizes, probe_ids,
+            k, inner_is_l2, sqrt, cap_q,
+            jax.default_backend() != "tpu")
+
     norms = jnp.sum(dataf * dataf, axis=2) if inner_is_l2 else None
     return _probe_scan(Q, dataf, norms, index.indices, index.list_sizes,
                        k, inner_is_l2, sqrt, probe_ids=probe_ids)

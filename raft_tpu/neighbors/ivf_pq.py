@@ -218,31 +218,64 @@ def _vq_train_batched(key, data, weights, book_size: int, n_iters: int):
     return lax.fori_loop(0, n_iters, em, centers0)
 
 
+# Row-chunk length for encode: the per-chunk distance block is
+# (chunk, pq_dim, book) f32 — at pq_dim=64, book=256 that is 64 KB/row, so
+# 4096 rows bound the workspace at 256 MB; chunking keeps encode
+# O(chunk·pq_dim·book) in HBM instead of materializing it for all n rows at
+# once (the reference's process_and_fill_codes kernel never materializes it
+# at all, ivf_pq_build.cuh:629 — it encodes as it packs).
+_ENCODE_CHUNK = 4096
+
+
+def _chunked_rows(fn, *arrays):
+    """Apply ``fn(rows...) -> (chunk, pq_dim)`` over row chunks of equal
+    leading length, padding the tail chunk."""
+    n = arrays[0].shape[0]
+    if n <= _ENCODE_CHUNK:
+        return fn(*arrays)
+    nc = ceildiv(n, _ENCODE_CHUNK)
+    pad = nc * _ENCODE_CHUNK - n
+    padded = [jnp.concatenate(
+        [a, jnp.zeros((pad,) + a.shape[1:], a.dtype)], axis=0) if pad else a
+        for a in arrays]
+    stacked = [a.reshape((nc, _ENCODE_CHUNK) + a.shape[1:]) for a in padded]
+    out = lax.map(lambda args: fn(*args), tuple(stacked))
+    return out.reshape((nc * _ENCODE_CHUNK,) + out.shape[2:])[:n]
+
+
 def _encode(residuals: jax.Array, pq_centers: jax.Array) -> jax.Array:
     """Nearest-codeword ids per subspace: residuals (n, pq_dim, l) against
     per-subspace books (pq_dim, k, l) → (n, pq_dim) uint8 (ref:
-    process_and_fill_codes kernel's encode step, ivf_pq_build.cuh:629)."""
-    d = (
-        jnp.sum(residuals * residuals, axis=2)[:, :, None]
-        + jnp.sum(pq_centers * pq_centers, axis=2)[None, :, :]
-        - 2.0 * jnp.einsum("njl,jkl->njk", residuals, pq_centers,
-                           precision=lax.Precision.HIGHEST)
-    )
-    return jnp.argmin(d, axis=2).astype(jnp.uint8)
+    process_and_fill_codes kernel's encode step, ivf_pq_build.cuh:629).
+    Chunked over rows to bound the (chunk, pq_dim, book) workspace."""
+
+    def enc(r):
+        d = (
+            jnp.sum(r * r, axis=2)[:, :, None]
+            + jnp.sum(pq_centers * pq_centers, axis=2)[None, :, :]
+            - 2.0 * jnp.einsum("njl,jkl->njk", r, pq_centers,
+                               precision=lax.Precision.HIGHEST)
+        )
+        return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+    return _chunked_rows(enc, residuals)
 
 
 def _encode_per_cluster(residuals, labels, pq_centers) -> jax.Array:
     """PER_CLUSTER encode: each row uses its own cluster's book
-    (pq_centers (n_lists, k, l))."""
-    books = pq_centers[labels]                            # (n, k, l)
-    r = residuals                                         # (n, pq_dim, l)
-    d = (
-        jnp.sum(r * r, axis=2)[:, :, None]
-        + jnp.sum(books * books, axis=2)[:, None, :]
-        - 2.0 * jnp.einsum("njl,nkl->njk", r, books,
-                           precision=lax.Precision.HIGHEST)
-    )
-    return jnp.argmin(d, axis=2).astype(jnp.uint8)
+    (pq_centers (n_lists, k, l)). Chunked over rows like :func:`_encode`."""
+
+    def enc(r, lab):
+        books = pq_centers[lab]                           # (chunk, k, l)
+        d = (
+            jnp.sum(r * r, axis=2)[:, :, None]
+            + jnp.sum(books * books, axis=2)[:, None, :]
+            - 2.0 * jnp.einsum("njl,nkl->njk", r, books,
+                               precision=lax.Precision.HIGHEST)
+        )
+        return jnp.argmin(d, axis=2).astype(jnp.uint8)
+
+    return _chunked_rows(enc, residuals, labels)
 
 
 def _residuals(X, labels, centers, rot, pq_dim: int) -> jax.Array:

@@ -179,6 +179,133 @@ def _fused_knn(queries, db, k: int, l2: bool, sqrt: bool,
     return outd, outi
 
 
+def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
+                      k: int, kp: int, bd: int, l2: bool, bf16: bool):
+    """One (batch, db-tile) grid cell of the batched independent kNN: same
+    distance-tile + k-pass selection as ``_fused_knn_kernel``, but each
+    batch element b searches only its own database slab, with per-slot
+    invalidity provided by ``bad_ref`` (capacity padding mask). The running
+    top-k stays VMEM-resident across the db-tile axis."""
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
+        outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
+
+    q = q_ref[0]
+    y = db_ref[0]
+    if bf16:
+        qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    else:
+        qc, yc = q, y
+    g = jax.lax.dot_general(
+        qc, yc, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(None if bf16 else jax.lax.Precision.HIGHEST))
+    if l2:
+        qn = jnp.sum(q * q, axis=1, keepdims=True)
+        yn = jnp.sum(y * y, axis=1)[None, :]
+        work = jnp.maximum(qn + yn - 2.0 * g, 0.0)
+    else:
+        work = -g
+    ids = j * bd + jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
+    work = jnp.where(bad_ref[0], jnp.inf, work)  # (1, bd) broadcasts
+
+    td, ti = _kpass_select(work, ids, k, kp)
+    nd, ni = _kpass_merge(outd_ref[0], outi_ref[0], td, ti, k, kp)
+    # Starved selection (fewer than k valid rows in this list): selected
+    # slots whose value is inf are masked-invalid or already-consumed
+    # columns carrying stale real ids — report the -1 sentinel like the
+    # scan engine's fewer-than-k semantics.
+    ni = jnp.where(jnp.isinf(nd), -1, ni)
+    outd_ref[0] = nd
+    outi_ref[0] = ni
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "l2", "sqrt", "bd", "bf16", "interpret"))
+def _fused_batch_knn(queries, db, bad, k: int, l2: bool, sqrt: bool,
+                     bd: int, bf16: bool, interpret: bool):
+    B, m, d = queries.shape
+    n = db.shape[1]
+    kp = round_up_safe(max(k, 1), _LANES)
+    mp = round_up_safe(m, 8)
+    np_ = round_up_safe(n, bd)
+    dp = round_up_safe(d, _LANES)
+    if mp != m or dp != d:
+        queries = jnp.pad(queries, ((0, 0), (0, mp - m), (0, dp - d)))
+    if np_ != n or dp != d:
+        db = jnp.pad(db, ((0, 0), (0, np_ - n), (0, dp - d)))
+    if np_ != n:
+        bad = jnp.pad(bad, ((0, 0), (0, np_ - n)), constant_values=True)
+    # (B, 1, n): a middle unit axis keeps the block's trailing two dims
+    # (1, bd) legal for the mosaic lowering (second-to-last == array dim).
+    bad = bad[:, None, :]
+    nb = np_ // bd
+
+    kernel = functools.partial(
+        _batch_knn_kernel, k=k, kp=kp, bd=bd, l2=l2, bf16=bf16)
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid=(B, nb),
+        in_specs=[
+            pl.BlockSpec((1, mp, dp), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bd, dp), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, bd), lambda b, j: (b, 0, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, mp, kp), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, mp, kp), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, mp, kp), jnp.float32),
+            jax.ShapeDtypeStruct((B, mp, kp), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(queries, db, bad)
+
+    outd = outd[:, :m, :k]
+    outi = outi[:, :m, :k]
+    if l2:
+        if sqrt:
+            outd = jnp.sqrt(outd)
+    else:
+        outd = -outd
+    return outd, outi
+
+
+def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
+                    sqrt: bool = False, bd: int = 0, bf16: bool = False,
+                    interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Batched independent fused kNN: element b searches ``queries[b]``
+    (m, d) against ``db[b]`` (n, d) with per-slot mask ``invalid[b]`` (n,)
+    bool. The engine of the IVF-Flat bucketed probe scan (one batch element
+    per probed list; ref: interleaved_scan_kernel's one-block-per-(query,
+    probe) decomposition, detail/ivf_flat_search.cuh:669, re-tiled for the
+    MXU). Returns (distances (B, m, k), local indices (B, m, k))."""
+    queries = jnp.asarray(queries, jnp.float32)
+    db = jnp.asarray(db, jnp.float32)
+    k = int(min(k, db.shape[1]))
+    n = db.shape[1]
+    if bd == 0:
+        bd = min(2048, round_up_safe(n, _LANES))
+    dp = round_up_safe(queries.shape[2], _LANES)
+    while bd > 256 and bd * dp * 4 > 4 * 1024 * 1024:
+        bd //= 2
+    bd = min(bd, round_up_safe(n, _LANES))
+    return _fused_batch_knn(queries, db, invalid, k, metric == "l2", sqrt,
+                            bd, bf16, interpret)
+
+
 def fused_knn_supported(m: int, n: int, d: int, k: int) -> bool:
     """Shapes the kernel handles well: k within one lane group of the
     top-k queue (the reference warpsort caps k at 256,
