@@ -303,6 +303,26 @@ def _probe_scan(
     return best_d, best_i
 
 
+def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
+                 k: int, bucket_cap: int, allow_bucketed: bool = True):
+    """Resolve SearchParams.engine/"auto" and the bucket capacity — shared
+    by ivf_flat.search and ivf_pq.search. Bucketed wins when the mean probe
+    load per list fills MXU tiles; tiny loads leave the batched kernel
+    mostly padding."""
+    expects(engine in ("auto", "scan", "bucketed"),
+            f"unknown engine {engine!r} (auto|scan|bucketed)")
+    if engine == "auto":
+        load = n_queries * n_probes / n_lists
+        engine = ("bucketed"
+                  if allow_bucketed and jax.default_backend() == "tpu"
+                  and load >= 32 and k <= 128 else "scan")
+    cap_q = bucket_cap
+    if engine == "bucketed" and cap_q == 0:
+        mean_load = max(1, (n_queries * n_probes) // n_lists)
+        cap_q = min(n_queries, 8 * ceildiv(4 * mean_load, 8))
+    return engine, cap_q
+
+
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
 def _bucketed_probe_scan(
     queries, data, indices, list_sizes, probe_ids,
@@ -352,7 +372,8 @@ def _bucketed_probe_scan(
     invalid = jnp.arange(cap, dtype=jnp.int32)[None, :] >= list_sizes[:, None]
     bd_, bi_ = fused_batch_knn(
         Qb, data, invalid, k,
-        metric="l2" if inner_is_l2 else "ip", interpret=interpret)
+        metric="l2" if inner_is_l2 else "ip",
+        bf16=data.dtype == jnp.bfloat16, interpret=interpret)
     kk = bd_.shape[2]                                          # min(k, cap)
     gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
                  jnp.maximum(bi_, 0)]                          # (L, cap_q, kk)
@@ -412,21 +433,9 @@ def search(
 
     dataf = _as_float(index.data)
 
-    engine = params.engine
-    expects(engine in ("auto", "scan", "bucketed"),
-            f"unknown engine {params.engine!r} (auto|scan|bucketed)")
-    if engine == "auto":
-        # Bucketed wins when the mean probe load per list fills MXU tiles;
-        # tiny loads leave the batched kernel mostly padding.
-        load = Q.shape[0] * n_probes / index.n_lists
-        engine = ("bucketed"
-                  if jax.default_backend() == "tpu" and load >= 32 and k <= 128
-                  else "scan")
+    engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
+                                 index.n_lists, k, params.bucket_cap)
     if engine == "bucketed":
-        cap_q = params.bucket_cap
-        if cap_q == 0:
-            mean_load = max(1, (Q.shape[0] * n_probes) // index.n_lists)
-            cap_q = min(Q.shape[0], 8 * ceildiv(4 * mean_load, 8))
         return _bucketed_probe_scan(
             Q, dataf, index.indices, index.list_sizes, probe_ids,
             k, inner_is_l2, sqrt, cap_q,

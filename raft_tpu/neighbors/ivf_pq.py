@@ -87,6 +87,11 @@ class SearchParams:
     n_probes: int = 20
     lut_dtype: object = jnp.float32
     internal_distance_dtype: object = jnp.float32
+    # TPU extension (see ivf_flat.SearchParams): "bucketed" scores probed
+    # lists as MXU matmuls against the bf16 reconstruction cache
+    # (Index.reconstructed) instead of LUT gathers; "scan" is the LUT path.
+    engine: str = "auto"
+    bucket_cap: int = 0
 
 
 @dataclass
@@ -107,6 +112,9 @@ class Index:
     list_sizes: jax.Array         # (n_lists,) int32
     pq_bits: int = 8
     conservative_memory_allocation: bool = False
+    # Lazy bf16 reconstruction cache (n_lists, cap, rot_dim) backing the
+    # bucketed search engine; see reconstructed(). Not serialized.
+    _recon: Optional[jax.Array] = None
 
     @property
     def n_lists(self) -> int:
@@ -140,6 +148,45 @@ class Index:
     @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
+
+    def reconstructed(self) -> jax.Array:
+        """Absolute reconstruction of every stored vector in rotated space,
+        bf16: ``recon[l, c] = R·center_l + codeword(codes[l, c])``.
+
+        ADC scoring (the LUT of compute_similarity_kernel,
+        ivf_pq_search.cuh:611) is exactly ``‖R·q − recon‖²`` because the
+        rotation is orthonormal and the subspaces are disjoint — so search
+        can run as a plain fused L2 kNN over this cache on the MXU instead
+        of LUT gathers (the decision point flagged in SURVEY.md §7). bf16
+        storage adds ~0.4% noise on top of the PQ quantization itself.
+        Cached on first use; O(n·rot_dim·2) bytes — for indexes too large
+        to afford that, use engine="scan".
+        """
+        if self._recon is None:
+            n_lists, cap, pq_dim = self.pq_codes.shape
+            codes = self.pq_codes.astype(jnp.int32)
+            if self.codebook_kind == CodebookGen.PER_SUBSPACE:
+                # books (pq_dim, book, pq_len): codeword j of row = books[j, code_j]
+                cw = jnp.take_along_axis(
+                    self.pq_centers[None, None],            # (1,1,J,B,L)
+                    codes[:, :, :, None, None], axis=3,
+                )[:, :, :, 0, :]                            # (l, c, J, L)
+            else:
+                # books (n_lists, book, pq_len), one book per list
+                cw = jnp.take_along_axis(
+                    self.pq_centers[:, None],               # (l,1,B,L)
+                    codes[:, :, :, None], axis=2,
+                )                                           # (l, c, J, L)
+            recon = cw.reshape(n_lists, cap, pq_dim * self.pq_len)
+            centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
+                                     precision=lax.Precision.HIGHEST)
+            recon = (recon + centers_rot[:, None, :]).astype(jnp.bfloat16)
+            if isinstance(recon, jax.core.Tracer):
+                # Called under jit: recompute per trace — never persist a
+                # tracer on the index (it would poison later eager calls).
+                return recon
+            object.__setattr__(self, "_recon", recon)
+        return self._recon
 
 
 def _as_float(x) -> jax.Array:
@@ -525,6 +572,29 @@ def search(
 
     rot = index.rotation_matrix
     rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
+
+    from raft_tpu.neighbors.ivf_flat import _bucketed_probe_scan, _pick_engine
+
+    # "auto" only switches to the recon-cache engine when the LUT dtype
+    # knobs are at their defaults — an explicit lut_dtype/internal dtype
+    # request is honored by the LUT scan path (an explicit
+    # engine="bucketed" overrides, documented on SearchParams).
+    default_dtypes = (jnp.dtype(params.lut_dtype) == jnp.float32
+                      and jnp.dtype(params.internal_distance_dtype)
+                      == jnp.float32)
+    engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
+                                 index.n_lists, k, params.bucket_cap,
+                                 allow_bucketed=default_dtypes)
+    if engine == "bucketed":
+        best_d, best_i = _bucketed_probe_scan(
+            rotq, index.reconstructed(),
+            index.indices, index.list_sizes, probe_ids,
+            k, not is_ip, False, cap_q,
+            jax.default_backend() != "tpu")
+        if index.metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+        return best_d, best_i
+
     centers_rot = jnp.matmul(index.centers, rot.T,
                              precision=lax.Precision.HIGHEST)
 

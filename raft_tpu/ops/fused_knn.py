@@ -204,8 +204,9 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
         preferred_element_type=jnp.float32,
         precision=(None if bf16 else jax.lax.Precision.HIGHEST))
     if l2:
+        yf = y.astype(jnp.float32)  # norms in f32 even for bf16-stored db
         qn = jnp.sum(q * q, axis=1, keepdims=True)
-        yn = jnp.sum(y * y, axis=1)[None, :]
+        yn = jnp.sum(yf * yf, axis=1)[None, :]
         work = jnp.maximum(qn + yn - 2.0 * g, 0.0)
     else:
         work = -g
@@ -291,9 +292,13 @@ def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
     bool. The engine of the IVF-Flat bucketed probe scan (one batch element
     per probed list; ref: interleaved_scan_kernel's one-block-per-(query,
     probe) decomposition, detail/ivf_flat_search.cuh:669, re-tiled for the
-    MXU). Returns (distances (B, m, k), local indices (B, m, k))."""
+    MXU). A bf16 ``db`` is accepted as-is when ``bf16=True`` (the IVF-PQ
+    reconstruction cache) — norms/accumulation stay f32.
+    Returns (distances (B, m, k), local indices (B, m, k))."""
     queries = jnp.asarray(queries, jnp.float32)
-    db = jnp.asarray(db, jnp.float32)
+    db = jnp.asarray(db)
+    if not (bf16 and db.dtype == jnp.bfloat16):
+        db = db.astype(jnp.float32)
     k = int(min(k, db.shape[1]))
     n = db.shape[1]
     if bd == 0:

@@ -5,8 +5,9 @@ test scheme (cpp/test/neighbors/ann_utils.cuh:121-162)."""
 
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
-from raft_tpu.neighbors import brute_force, ivf_flat
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
 from raft_tpu.ops.fused_knn import fused_batch_knn
 
 
@@ -82,6 +83,52 @@ def test_bucketed_matches_scan_engine(rng):
     assert agree > 0.999, f"bucketed(full cap) != scan: {agree}"
     np.testing.assert_allclose(np.sort(np.asarray(bd), 1),
                                np.sort(np.asarray(sd), 1), atol=1e-3)
+
+
+@pytest.mark.parametrize("kind", [ivf_pq.CodebookGen.PER_SUBSPACE,
+                                  ivf_pq.CodebookGen.PER_CLUSTER])
+def test_ivf_pq_bucketed_matches_lut_scan(rng, kind):
+    """ADC over the reconstruction cache must rank like the LUT scan — the
+    two are the same math (‖R·q − (R·c + codeword)‖²); bf16 recon storage
+    may flip only distance-degenerate tail entries."""
+    n, d, qn, k = 3000, 32, 150, 10
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    Q = rng.normal(size=(qn, d)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=5, pq_dim=16,
+                           codebook_kind=kind), db)
+    ed, ei = brute_force.knn(db, Q, k)
+    sd, si = ivf_pq.search(ivf_pq.SearchParams(n_probes=8, engine="scan"),
+                           idx, Q, k)
+    bd, bi = ivf_pq.search(
+        ivf_pq.SearchParams(n_probes=8, engine="bucketed", bucket_cap=qn),
+        idx, Q, k)
+    rec_s = np.mean([len(np.intersect1d(np.asarray(si)[r],
+                                        np.asarray(ei)[r])) / k
+                     for r in range(qn)])
+    rec_b = np.mean([len(np.intersect1d(np.asarray(bi)[r],
+                                        np.asarray(ei)[r])) / k
+                     for r in range(qn)])
+    assert rec_b >= rec_s - 0.02, (rec_b, rec_s)
+    agree = np.mean([len(np.intersect1d(np.asarray(si)[r],
+                                        np.asarray(bi)[r])) / k
+                     for r in range(qn)])
+    assert agree > 0.95, agree
+
+
+def test_ivf_pq_recon_cache_no_tracer_poisoning(rng):
+    """reconstructed() under jit must not persist a tracer on the index
+    (later eager searches would raise UnexpectedTracerError)."""
+    import jax
+
+    db = rng.normal(size=(1500, 32)).astype(np.float32)
+    Q = rng.normal(size=(40, 32)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=8, kmeans_n_iters=3, pq_dim=16), db)
+    sp = ivf_pq.SearchParams(n_probes=4, engine="bucketed", bucket_cap=40)
+    d1, i1 = jax.jit(lambda q: ivf_pq.search(sp, idx, q, 5))(Q)
+    d2, i2 = ivf_pq.search(sp, idx, Q, 5)  # eager after traced
+    np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-3)
 
 
 def test_bucketed_auto_cap_recall(rng):
