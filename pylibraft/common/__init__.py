@@ -5,26 +5,11 @@ DeviceResources, Stream, device_ndarray, cai_wrapper, ai_wrapper).
 """
 
 from pylibraft.common.ai_wrapper import ai_wrapper, cai_wrapper
+from pylibraft.common.cuda import Stream
 from pylibraft.common.device_ndarray import device_ndarray
 from pylibraft.common.handle import DeviceResources, Handle, auto_sync_handle
+from pylibraft.common.interruptible import cuda_interruptible, synchronize
 from pylibraft.common.outputs import auto_convert_output, set_output_as
-
-
-class Stream:
-    """CUDA stream stand-in (ref common/cuda.pyx). XLA's single ordered
-    async dispatch queue per device plays the stream role; this object is
-    kept so `DeviceResources(stream=...)`-style code imports cleanly."""
-
-    def __init__(self):
-        pass
-
-    def sync(self) -> None:
-        import jax
-
-        try:
-            jax.effects_barrier()
-        except Exception:
-            pass
 
 
 __all__ = [
@@ -35,6 +20,8 @@ __all__ = [
     "auto_convert_output",
     "auto_sync_handle",
     "cai_wrapper",
+    "cuda_interruptible",
     "device_ndarray",
     "set_output_as",
+    "synchronize",
 ]

@@ -1,0 +1,44 @@
+"""Stream shim.
+
+Ref: python/pylibraft/pylibraft/common/cuda.pyx — a thin ``Stream`` class
+over ``cudaStream_t`` (create/sync/destroy) handed to ``DeviceResources``.
+XLA owns its execution streams, so the TPU ``Stream`` is a handle onto a
+device's async dispatch queue: ``sync()`` drains it. Kept so pylibraft
+callers that construct/pass streams keep working unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Stream:
+    """Ref: common/cuda.pyx ``Stream``. On TPU, a named view of a device's
+    dispatch queue; per-stream concurrency is XLA's async dispatch."""
+
+    def __init__(self, device: Optional[object] = None):
+        # Lazy: constructing a Stream must not initialize the JAX backend
+        # (callers may build inert handles before configuring platforms).
+        self._device = device
+
+    @property
+    def device(self):
+        if self._device is None:
+            import jax
+
+            self._device = jax.devices()[0]
+        return self._device
+
+    def sync(self) -> None:
+        """Block until dispatched work on this device completes
+        (ref: cuda.pyx Stream.sync → cudaStreamSynchronize)."""
+        import jax
+
+        try:
+            jax.effects_barrier()
+        except Exception:
+            pass
+
+    def get_ptr(self) -> int:
+        """Opaque id (ref: cuda.pyx getStream); TPU has no raw pointer."""
+        return id(self.device)

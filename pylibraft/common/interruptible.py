@@ -1,0 +1,49 @@
+"""SIGINT-driven cooperative cancellation.
+
+Ref: python/pylibraft/pylibraft/common/interruptible.pyx — a context
+manager that installs a SIGINT handler calling
+``raft::interruptible::cancel()`` on the captured token, so a blocked
+``synchronize`` raises instead of hanging. Delegates to
+:mod:`raft_tpu.core.interruptible`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import signal
+import threading
+
+from raft_tpu.core.interruptible import (  # noqa: F401 (re-exports)
+    Interruptible,
+    InterruptedException,
+    synchronize,
+)
+
+
+@contextlib.contextmanager
+def cuda_interruptible():
+    """Ref: interruptible.pyx ``cuda_interruptible`` — cancel the current
+    thread's token on SIGINT for the duration of the scope."""
+    token = Interruptible.get_token()
+    if threading.current_thread() is not threading.main_thread():
+        # Signal handlers are main-thread only; nested scopes still get
+        # cancellation via their parent's token.
+        yield
+        return
+    prev = signal.getsignal(signal.SIGINT)
+
+    def handler(signum, frame):
+        # Cancel the token (wakes a blocked synchronize) AND chain to the
+        # previous handler so host-side code between syncs still gets its
+        # KeyboardInterrupt — Ctrl-C must never be swallowed.
+        token.cancel()
+        if callable(prev):
+            prev(signum, frame)
+        else:
+            raise KeyboardInterrupt
+
+    signal.signal(signal.SIGINT, handler)
+    try:
+        yield
+    finally:
+        signal.signal(signal.SIGINT, prev)

@@ -159,8 +159,11 @@ class Index:
         can run as a plain fused L2 kNN over this cache on the MXU instead
         of LUT gathers (the decision point flagged in SURVEY.md §7). bf16
         storage adds ~0.4% noise on top of the PQ quantization itself.
-        Cached on first use; O(n·rot_dim·2) bytes — for indexes too large
-        to afford that, use engine="scan".
+        Cached on first use; n_lists·cap·rot_dim·2 bytes of *padded*
+        capacity (plus a transient f32 intermediate ~2× that during
+        construction) — this trades PQ's compression back for speed, so
+        engine="auto" only engages it below _RECON_AUTO_BYTES; larger
+        indexes need an explicit engine="bucketed" (or stay on "scan").
         """
         if self._recon is None:
             n_lists, cap, pq_dim = self.pq_codes.shape
@@ -272,6 +275,11 @@ def _vq_train_batched(key, data, weights, book_size: int, n_iters: int):
 # once (the reference's process_and_fill_codes kernel never materializes it
 # at all, ivf_pq_build.cuh:629 — it encodes as it packs).
 _ENCODE_CHUNK = 4096
+
+# engine="auto" only switches to the reconstruction-cache search while the
+# (padded) bf16 cache stays below this; beyond it, the cache would defeat
+# PQ's compression — the user must opt in with engine="bucketed".
+_RECON_AUTO_BYTES = 4 * 1024 ** 3
 
 
 def _chunked_rows(fn, *arrays):
@@ -582,9 +590,12 @@ def search(
     default_dtypes = (jnp.dtype(params.lut_dtype) == jnp.float32
                       and jnp.dtype(params.internal_distance_dtype)
                       == jnp.float32)
-    engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
-                                 index.n_lists, k, params.bucket_cap,
-                                 allow_bucketed=default_dtypes)
+    recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
+        * index.rot_dim * 2
+    engine, cap_q = _pick_engine(
+        params.engine, Q.shape[0], n_probes, index.n_lists, k,
+        params.bucket_cap,
+        allow_bucketed=default_dtypes and recon_bytes <= _RECON_AUTO_BYTES)
     if engine == "bucketed":
         best_d, best_i = _bucketed_probe_scan(
             rotq, index.reconstructed(),

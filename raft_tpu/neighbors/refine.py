@@ -76,3 +76,35 @@ def refine(
     dist, pos = select_k(d, k, select_min=select_min)
     idx = jnp.take_along_axis(cand, pos, axis=1)
     return dist, idx
+
+
+def refine_host(dataset, queries, candidates, k: int,
+                metric: Union[str, DistanceType] = DistanceType.L2Expanded,
+                ) -> Tuple["jnp.ndarray", "jnp.ndarray"]:
+    """Host-side refinement over NumPy arrays on the native thread pool.
+
+    Ref: the reference's host overload of raft::neighbors::refine
+    (detail/refine.cuh:162 — OpenMP exact re-scan). Delegates to the C++
+    runtime (native/host_runtime.cpp via raft_tpu._native.refine_host),
+    falling back to a NumPy implementation when the shared library is
+    unavailable. L2-family metrics only, like the reference host path.
+    Returns NumPy ``(distances (q, k), indices (q, k))``.
+    """
+    import numpy as _np
+
+    from raft_tpu import _native
+
+    metric = resolve_metric(metric)
+    expects(metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded,
+                       DistanceType.L2Unexpanded,
+                       DistanceType.L2SqrtUnexpanded),
+            f"refine_host supports L2 metrics, got {metric!r}")
+    ds = _np.ascontiguousarray(_np.asarray(dataset, _np.float32))
+    q = _np.ascontiguousarray(_np.asarray(queries, _np.float32))
+    # int64 straight through: the native ABI is int64, and int32 would wrap
+    # translated id spaces (knn_merge_parts offsets) above 2^31.
+    cand = _np.ascontiguousarray(_np.asarray(candidates, _np.int64))
+    d, i = _native.refine_host(ds, q, cand, k)
+    if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
+        d = _np.sqrt(d)
+    return d, i

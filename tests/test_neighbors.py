@@ -196,3 +196,18 @@ class TestIvfFlat:
         d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), index, q, 5)
         _, truth = _naive_knn(q, db, 5, metric="inner_product")
         assert _recall(np.asarray(i), truth) > 0.95
+
+
+def test_refine_host_matches_device(rng):
+    """Host (native thread-pool) refine == device refine (ref: host
+    overload of raft::neighbors::refine, detail/refine.cuh:162)."""
+    from raft_tpu.neighbors.refine import refine, refine_host
+
+    ds = rng.normal(size=(400, 16)).astype(np.float32)
+    q = rng.normal(size=(16, 16)).astype(np.float32)
+    d2 = ((q[:, None] - ds[None]) ** 2).sum(-1)
+    cand = np.argsort(d2, 1)[:, :25][:, ::-1].copy().astype(np.int32)
+    hd, hi = refine_host(ds, q, cand, 5)
+    dd, di = refine(ds, q, cand, 5)
+    np.testing.assert_array_equal(hi, np.asarray(di))
+    np.testing.assert_allclose(hd, np.asarray(dd), rtol=1e-4)

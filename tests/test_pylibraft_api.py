@@ -288,3 +288,36 @@ class TestRandom:
         assert out.max() < 256
         # skew towards low ids from the (a,b,c,d) weighting
         assert (out[:, 0] < 128).mean() > 0.55
+
+
+class TestCommonShims:
+    def test_stream(self):
+        from pylibraft.common import Stream
+
+        s = Stream()
+        s.sync()
+        assert isinstance(s.get_ptr(), int)
+
+    def test_interruptible_scope(self):
+        import jax.numpy as jnp
+
+        from pylibraft.common import cuda_interruptible, synchronize
+
+        with cuda_interruptible():
+            x = jnp.arange(16.0) * 3
+            synchronize(x)
+        assert float(x[1]) == 3.0
+
+    def test_cancel_raises(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from pylibraft.common.interruptible import (
+            Interruptible,
+            InterruptedException,
+            synchronize,
+        )
+
+        Interruptible.get_token().cancel()
+        with pytest.raises(InterruptedException):
+            synchronize(jnp.ones(4))
