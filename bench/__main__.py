@@ -143,6 +143,13 @@ def bench_neighbors(rng, quick: bool):
            n_db=n, dim=d, n_lists=n_lists)
     pidx = ivf_pq.build(pp, db)
     psp = ivf_pq.SearchParams(n_probes=n_probes)
+    import jax
+
+    if jax.default_backend() == "tpu":
+        # Warm the ADC reconstruction cache eagerly: inside scan_time's jit
+        # the decode would otherwise re-run every scan iteration (XLA does
+        # not hoist the chunked lax.map out of the loop).
+        pidx.reconstructed()
     sec = scan_time(lambda x: ivf_pq.search(psp, pidx, x, k), qs)
     report("neighbors", "ivf_pq_search", sec, q, unit="qps",
            n_db=n, dim=d, n_probes=n_probes, k=k)

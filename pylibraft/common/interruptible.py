@@ -33,17 +33,23 @@ def cuda_interruptible():
     prev = signal.getsignal(signal.SIGINT)
 
     def handler(signum, frame):
-        # Cancel the token (wakes a blocked synchronize) AND chain to the
-        # previous handler so host-side code between syncs still gets its
-        # KeyboardInterrupt — Ctrl-C must never be swallowed.
+        # Cancel the token (wakes worker threads blocked in synchronize),
+        # then defer to the prior disposition: chain a Python handler, or
+        # raise KeyboardInterrupt for the default — but respect an explicit
+        # SIG_IGN (e.g. multiprocessing pool workers) and a non-Python
+        # handler (getsignal() → None) by cancelling only.
         token.cancel()
         if callable(prev):
             prev(signum, frame)
-        else:
+        elif prev == signal.SIG_DFL:
             raise KeyboardInterrupt
 
     signal.signal(signal.SIGINT, handler)
     try:
         yield
     finally:
-        signal.signal(signal.SIGINT, prev)
+        if prev is not None:
+            signal.signal(signal.SIGINT, prev)
+        # A KeyboardInterrupt consumed by the caller must not leave the
+        # cancel flag set — it would poison the next synchronize.
+        token.reset()

@@ -73,28 +73,33 @@ def predict(
     return jnp.argmax(d, axis=1).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _balanced_em(X, centroids0, n_iters: int, n_clusters: int):
-    """Balancing EM (ref: balancing_em_iters, detail/kmeans_balanced.cuh:616):
-    each iteration assigns, recomputes means, then re-seeds under-populated
-    clusters from the highest-cost samples (adjust_centers:522)."""
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _balanced_em_weighted(X, w, centroids0, n_iters: int, n_clusters: int):
+    """Balancing EM (ref: balancing_em_iters, detail/kmeans_balanced.cuh:616)
+    with a per-row validity weight ``w`` (1 real / 0 padding) so callers can
+    pad the row dimension to shared compile shapes — each iteration assigns,
+    recomputes weighted means, then re-seeds under-populated clusters from
+    the highest-cost real samples (adjust_centers:522)."""
     n = X.shape[0]
-    avg = n / n_clusters
-    threshold = jnp.asarray(max(1.0, _SMALL_RATIO * avg), X.dtype)
+    n_valid = jnp.sum(w)
+    threshold = jnp.maximum(
+        jnp.asarray(1.0, X.dtype),
+        (_SMALL_RATIO * n_valid / n_clusters).astype(X.dtype))
 
     def body(_, centroids):
         dists, labels = fused_l2_nn_min_reduce(X, centroids)
-        sums = jax.ops.segment_sum(X, labels, num_segments=n_clusters)
-        counts = jax.ops.segment_sum(
-            jnp.ones((n,), X.dtype), labels, num_segments=n_clusters
-        )
+        sums = jax.ops.segment_sum(X * w[:, None], labels,
+                                   num_segments=n_clusters)
+        counts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
         new = sums / jnp.maximum(counts, 1.0)[:, None]
         new = jnp.where((counts > 0)[:, None], new, centroids)
 
         # adjust_centers: rank clusters by population; rank samples by cost.
         # The i-th most under-populated cluster is re-seeded to the i-th
         # highest-cost sample (a deterministic variant of the reference's
-        # probabilistic pick from high-cost samples).
+        # probabilistic pick from high-cost samples). Padding rows carry
+        # -inf cost so they are never picked as seeds.
+        dists = jnp.where(w > 0, dists, -jnp.inf)
         order = jnp.argsort(counts)                      # ascending population
         rank = jnp.argsort(order)                        # cluster -> its rank
         n_small = jnp.sum(counts < threshold)
@@ -104,6 +109,27 @@ def _balanced_em(X, centroids0, n_iters: int, n_clusters: int):
         return jnp.where(reseed[:, None], seeds, new)
 
     return lax.fori_loop(0, n_iters, body, centroids0)
+
+
+def _balanced_em(X, centroids0, n_iters: int, n_clusters: int):
+    return _balanced_em_weighted(
+        X, jnp.ones((X.shape[0],), X.dtype), centroids0, n_iters, n_clusters)
+
+
+def _host_kmeans_pp_seed(X: np.ndarray, k: int, rng) -> np.ndarray:
+    """k-means++ seeding on the host (NumPy) — used for the hierarchical
+    sub-fits so good seeds don't cost one device compilation per sub-fit
+    shape (ref: the same D²-sampling as kmeansPlusPlus,
+    cluster/detail/kmeans.cuh:~120)."""
+    n = X.shape[0]
+    seeds = np.empty((k, X.shape[1]), X.dtype)
+    seeds[0] = X[rng.integers(n)]
+    d2 = ((X - seeds[0]) ** 2).sum(1)
+    for i in range(1, k):
+        p = d2 / max(d2.sum(), 1e-30)
+        seeds[i] = X[rng.choice(n, p=p)]
+        d2 = np.minimum(d2, ((X - seeds[i]) ** 2).sum(1))
+    return seeds
 
 
 def build_clusters(
@@ -180,7 +206,29 @@ def fit(
             reps = np.resize(members, (km, d))
             fine.append(reps)
             continue
-        sub = build_clusters(params, jnp.asarray(members), km)
+        # Pad rows to a power-of-two bucket with zero weights so the 32-odd
+        # sub-fits share a handful of compile shapes instead of one XLA
+        # compilation each (the dominant cost of build_hierarchical over a
+        # high-latency device link). Seeding stays on the real rows — k++
+        # on the host for small km (build_clusters' km<=64 rule: strided
+        # seeds hit the merged-blob local optimum), strided otherwise.
+        nv = len(members)
+        npad = max(64, 1 << (nv - 1).bit_length())
+        pad_rows = npad - nv
+        Xp = np.concatenate(
+            [members, np.zeros((pad_rows, d), Xh.dtype)]) if pad_rows else members
+        wp = np.zeros((npad,), Xh.dtype)
+        wp[:nv] = 1.0
+        if km <= 64:
+            c0 = _host_kmeans_pp_seed(members, km,
+                                      np.random.default_rng(1000 + m))
+        else:
+            stride = max(nv // km, 1)
+            c0 = members[::stride][:km]
+            if len(c0) < km:
+                c0 = np.resize(members, (km, d))
+        sub = _balanced_em_weighted(jnp.asarray(Xp), jnp.asarray(wp),
+                                    jnp.asarray(c0), params.n_iters, km)
         fine.append(np.asarray(sub))
     centroids = jnp.asarray(np.concatenate(fine, axis=0))
 

@@ -58,6 +58,12 @@ class Interruptible:
         """Request cancellation (ref: interruptible::cancel)."""
         self._cancelled.set()
 
+    def reset(self) -> None:
+        """Clear a pending cancellation without raising (used by scoped
+        SIGINT hooks on exit so a consumed-elsewhere interrupt cannot
+        poison a later synchronize)."""
+        self._cancelled.clear()
+
     @classmethod
     def cancel_thread(cls, thread_id: int) -> None:
         cls.get_token(thread_id).cancel()

@@ -315,7 +315,7 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
         load = n_queries * n_probes / n_lists
         engine = ("bucketed"
                   if allow_bucketed and jax.default_backend() == "tpu"
-                  and load >= 32 and k <= 128 else "scan")
+                  and load >= 8 and k <= 128 else "scan")
     cap_q = bucket_cap
     if engine == "bucketed" and cap_q == 0:
         mean_load = max(1, (n_queries * n_probes) // n_lists)
@@ -442,8 +442,23 @@ def search(
             jax.default_backend() != "tpu")
 
     norms = jnp.sum(dataf * dataf, axis=2) if inner_is_l2 else None
-    return _probe_scan(Q, dataf, norms, index.indices, index.list_sizes,
-                       k, inner_is_l2, sqrt, probe_ids=probe_ids)
+    # The scan engine's per-probe gather is (q_chunk, cap, dim) — chunk the
+    # query axis so the workspace stays bounded at large cap (at cap=2048,
+    # d=128, 1000 unchunked queries would stage ~1 GB per probe step).
+    cap = dataf.shape[1]
+    chunk = max(1, min(Q.shape[0],
+                       (64 * 1024 * 1024) // max(cap * index.dim * 4, 1)))
+    if Q.shape[0] <= chunk:
+        return _probe_scan(Q, dataf, norms, index.indices, index.list_sizes,
+                           k, inner_is_l2, sqrt, probe_ids=probe_ids)
+    outs_d, outs_i = [], []
+    for s in range(0, Q.shape[0], chunk):
+        d_, i_ = _probe_scan(Q[s:s + chunk], dataf, norms, index.indices,
+                             index.list_sizes, k, inner_is_l2, sqrt,
+                             probe_ids=probe_ids[s:s + chunk])
+        outs_d.append(d_)
+        outs_i.append(i_)
+    return jnp.concatenate(outs_d, axis=0), jnp.concatenate(outs_i, axis=0)
 
 
 # ---------------------------------------------------------------------------

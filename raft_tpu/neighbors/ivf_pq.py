@@ -164,26 +164,67 @@ class Index:
         construction) — this trades PQ's compression back for speed, so
         engine="auto" only engages it below _RECON_AUTO_BYTES; larger
         indexes need an explicit engine="bucketed" (or stay on "scan").
+
+        Call this eagerly once before wrapping ``search`` in jit/scan:
+        under a trace the cache cannot persist, and inside a ``lax.scan``
+        body XLA will re-run the decode every iteration.
         """
         if self._recon is None:
-            n_lists, cap, pq_dim = self.pq_codes.shape
-            codes = self.pq_codes.astype(jnp.int32)
-            if self.codebook_kind == CodebookGen.PER_SUBSPACE:
-                # books (pq_dim, book, pq_len): codeword j of row = books[j, code_j]
-                cw = jnp.take_along_axis(
-                    self.pq_centers[None, None],            # (1,1,J,B,L)
-                    codes[:, :, :, None, None], axis=3,
-                )[:, :, :, 0, :]                            # (l, c, J, L)
-            else:
-                # books (n_lists, book, pq_len), one book per list
-                cw = jnp.take_along_axis(
-                    self.pq_centers[:, None],               # (l,1,B,L)
-                    codes[:, :, :, None], axis=2,
-                )                                           # (l, c, J, L)
-            recon = cw.reshape(n_lists, cap, pq_dim * self.pq_len)
+            n_lists, cap, J = self.pq_codes.shape
+            B, L = self.pq_book_size, self.pq_len
+            per_cluster = self.codebook_kind == CodebookGen.PER_CLUSTER
+            # Flat 1-D gather with a (rows, J·L = rot_dim) output: a naive
+            # per-subspace take_along_axis emits (…, L) arrays whose tiny
+            # trailing dim the TPU layout pads to 128 lanes — a 64×
+            # allocation blowup at pq_len=2 (observed 64 GiB at SIFT-1M).
+            flat_books = self.pq_centers.reshape(-1)
+            lp = jnp.arange(L, dtype=jnp.int32)
+            jbase = (jnp.arange(J, dtype=jnp.int32) * B * L)[None, :, None]
             centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
                                      precision=lax.Precision.HIGHEST)
-            recon = (recon + centers_rot[:, None, :]).astype(jnp.bfloat16)
+
+            def decode_lists(args):
+                # per-subspace books: one shared flat book table
+                codes_c, crot_c = args                     # (lc, cap, J), (lc, rot)
+                lc = codes_c.shape[0]
+                codes2 = codes_c.reshape(lc * cap, J).astype(jnp.int32)
+                idx = jbase + codes2[:, :, None] * L + lp[None, None, :]
+                cw = flat_books[idx.reshape(lc * cap, J * L)]
+                cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
+                return cw.astype(jnp.bfloat16)
+
+            chunk = max(1, min(n_lists, (1 << 25) // max(cap, 1)))
+            if n_lists % chunk:
+                chunk = 1 << (chunk.bit_length() - 1)
+                while n_lists % chunk and chunk > 1:
+                    chunk //= 2
+            nc = n_lists // chunk
+            if per_cluster:
+                # each chunk needs its own books — gather flat per chunk
+                books_c = self.pq_centers.reshape(nc, chunk * B * L)
+
+                def decode_pc(args):
+                    codes_c, crot_c, fb = args
+                    lc = codes_c.shape[0]
+                    codes2 = codes_c.reshape(lc * cap, J).astype(jnp.int32)
+                    base = jnp.repeat(
+                        jnp.arange(lc, dtype=jnp.int32) * (B * L), cap
+                    )[:, None, None]
+                    idx = base + codes2[:, :, None] * L + lp[None, None, :]
+                    cw = fb[idx.reshape(lc * cap, J * L)]
+                    cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
+                    return cw.astype(jnp.bfloat16)
+
+                recon = lax.map(decode_pc, (
+                    self.pq_codes.reshape(nc, chunk, cap, J),
+                    centers_rot.reshape(nc, chunk, -1),
+                    books_c,
+                )).reshape(n_lists, cap, J * L)
+            else:
+                recon = lax.map(decode_lists, (
+                    self.pq_codes.reshape(nc, chunk, cap, J),
+                    centers_rot.reshape(nc, chunk, -1),
+                )).reshape(n_lists, cap, J * L)
             if isinstance(recon, jax.core.Tracer):
                 # Called under jit: recompute per trace — never persist a
                 # tracer on the index (it would poison later eager calls).
@@ -609,13 +650,31 @@ def search(
     centers_rot = jnp.matmul(index.centers, rot.T,
                              precision=lax.Precision.HIGHEST)
 
-    best_d, best_i = _pq_probe_scan(
-        rotq, probe_ids,
-        index.pq_codes, index.indices, index.list_sizes,
-        k, is_ip, index.codebook_kind == CodebookGen.PER_CLUSTER,
-        jnp.dtype(params.lut_dtype),
-        pq_centers=index.pq_centers, centers_rot=centers_rot,
-    )
+    # Chunk the query axis: the LUT scan stages (q_chunk, cap, pq_dim)
+    # gathered codes plus a (q_chunk, pq_dim, book) LUT per probe step —
+    # unchunked at cap=2048, pq_dim=64 a 1000-query batch is ~0.5 GB of
+    # gather per step (enough to take down the worker at 1M scale).
+    cap = index.pq_codes.shape[1]
+    per_q = max(cap * index.pq_dim * 4, index.pq_dim * 256 * 4)
+    chunk = max(1, min(Q.shape[0], (64 * 1024 * 1024) // per_q))
+
+    def run_chunk(rq, pid):
+        d_, i_ = _pq_probe_scan(
+            rq, pid,
+            index.pq_codes, index.indices, index.list_sizes,
+            k, is_ip, index.codebook_kind == CodebookGen.PER_CLUSTER,
+            jnp.dtype(params.lut_dtype),
+            pq_centers=index.pq_centers, centers_rot=centers_rot,
+        )
+        return d_, i_
+
+    if Q.shape[0] <= chunk:
+        best_d, best_i = run_chunk(rotq, probe_ids)
+    else:
+        outs = [run_chunk(rotq[s:s + chunk], probe_ids[s:s + chunk])
+                for s in range(0, Q.shape[0], chunk)]
+        best_d = jnp.concatenate([o[0] for o in outs], axis=0)
+        best_i = jnp.concatenate([o[1] for o in outs], axis=0)
     if index.metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i

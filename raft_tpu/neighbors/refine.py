@@ -99,12 +99,10 @@ def refine_host(dataset, queries, candidates, k: int,
                        DistanceType.L2Unexpanded,
                        DistanceType.L2SqrtUnexpanded),
             f"refine_host supports L2 metrics, got {metric!r}")
-    ds = _np.ascontiguousarray(_np.asarray(dataset, _np.float32))
-    q = _np.ascontiguousarray(_np.asarray(queries, _np.float32))
-    # int64 straight through: the native ABI is int64, and int32 would wrap
-    # translated id spaces (knn_merge_parts offsets) above 2^31.
-    cand = _np.ascontiguousarray(_np.asarray(candidates, _np.int64))
-    d, i = _native.refine_host(ds, q, cand, k)
+    # dtype/contiguity conversion is owned by the _native wrapper (it
+    # normalizes to f32/int64 contiguous itself).
+    d, i = _native.refine_host(_np.asarray(dataset), _np.asarray(queries),
+                               _np.asarray(candidates), k)
     if metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded):
         d = _np.sqrt(d)
     return d, i
