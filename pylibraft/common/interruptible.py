@@ -31,6 +31,12 @@ def cuda_interruptible():
         yield
         return
     prev = signal.getsignal(signal.SIGINT)
+    if prev is None:
+        # A non-Python (C-level) handler is installed: we could neither
+        # chain to it nor restore it afterwards, so leave it untouched —
+        # cancellation simply isn't hooked to SIGINT in this scope.
+        yield
+        return
 
     def handler(signum, frame):
         # Cancel the token (wakes worker threads blocked in synchronize),
@@ -48,8 +54,7 @@ def cuda_interruptible():
     try:
         yield
     finally:
-        if prev is not None:
-            signal.signal(signal.SIGINT, prev)
+        signal.signal(signal.SIGINT, prev)
         # A KeyboardInterrupt consumed by the caller must not leave the
         # cancel flag set — it would poison the next synchronize.
         token.reset()

@@ -126,8 +126,14 @@ def _host_kmeans_pp_seed(X: np.ndarray, k: int, rng) -> np.ndarray:
     seeds[0] = X[rng.integers(n)]
     d2 = ((X - seeds[0]) ** 2).sum(1)
     for i in range(1, k):
-        p = d2 / max(d2.sum(), 1e-30)
-        seeds[i] = X[rng.choice(n, p=p)]
+        total = d2.sum()
+        if total <= 0:
+            # Fewer distinct points than seeds (duplicate-heavy data):
+            # remaining seeds sample uniformly, matching the reference's
+            # degenerate-trainset behavior.
+            seeds[i:] = X[rng.integers(n, size=k - i)]
+            break
+        seeds[i] = X[rng.choice(n, p=d2 / total)]
         d2 = np.minimum(d2, ((X - seeds[i]) ** 2).sum(1))
     return seeds
 

@@ -303,6 +303,21 @@ def _probe_scan(
     return best_d, best_i
 
 
+def _chunked_over_queries(fn, Q, probe_ids, per_q_bytes: int,
+                          budget: int = 64 * 1024 * 1024):
+    """Run ``fn(Q_chunk, probe_ids_chunk) -> (d, i)`` over query chunks
+    sized so the per-chunk probe workspace stays under ``budget`` bytes —
+    shared by both scan engines (their per-probe gather is
+    O(q_chunk · per_q_bytes))."""
+    chunk = max(1, min(Q.shape[0], budget // max(per_q_bytes, 1)))
+    if Q.shape[0] <= chunk:
+        return fn(Q, probe_ids)
+    outs = [fn(Q[s:s + chunk], probe_ids[s:s + chunk])
+            for s in range(0, Q.shape[0], chunk)]
+    return (jnp.concatenate([o[0] for o in outs], axis=0),
+            jnp.concatenate([o[1] for o in outs], axis=0))
+
+
 def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
                  k: int, bucket_cap: int, allow_bucketed: bool = True):
     """Resolve SearchParams.engine/"auto" and the bucket capacity — shared
@@ -445,20 +460,11 @@ def search(
     # The scan engine's per-probe gather is (q_chunk, cap, dim) — chunk the
     # query axis so the workspace stays bounded at large cap (at cap=2048,
     # d=128, 1000 unchunked queries would stage ~1 GB per probe step).
-    cap = dataf.shape[1]
-    chunk = max(1, min(Q.shape[0],
-                       (64 * 1024 * 1024) // max(cap * index.dim * 4, 1)))
-    if Q.shape[0] <= chunk:
-        return _probe_scan(Q, dataf, norms, index.indices, index.list_sizes,
-                           k, inner_is_l2, sqrt, probe_ids=probe_ids)
-    outs_d, outs_i = [], []
-    for s in range(0, Q.shape[0], chunk):
-        d_, i_ = _probe_scan(Q[s:s + chunk], dataf, norms, index.indices,
-                             index.list_sizes, k, inner_is_l2, sqrt,
-                             probe_ids=probe_ids[s:s + chunk])
-        outs_d.append(d_)
-        outs_i.append(i_)
-    return jnp.concatenate(outs_d, axis=0), jnp.concatenate(outs_i, axis=0)
+    return _chunked_over_queries(
+        lambda q_, p_: _probe_scan(q_, dataf, norms, index.indices,
+                                   index.list_sizes, k, inner_is_l2, sqrt,
+                                   probe_ids=p_),
+        Q, probe_ids, dataf.shape[1] * index.dim * 4)
 
 
 # ---------------------------------------------------------------------------

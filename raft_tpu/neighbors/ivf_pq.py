@@ -654,27 +654,19 @@ def search(
     # gathered codes plus a (q_chunk, pq_dim, book) LUT per probe step —
     # unchunked at cap=2048, pq_dim=64 a 1000-query batch is ~0.5 GB of
     # gather per step (enough to take down the worker at 1M scale).
+    from raft_tpu.neighbors.ivf_flat import _chunked_over_queries
+
     cap = index.pq_codes.shape[1]
     per_q = max(cap * index.pq_dim * 4, index.pq_dim * 256 * 4)
-    chunk = max(1, min(Q.shape[0], (64 * 1024 * 1024) // per_q))
-
-    def run_chunk(rq, pid):
-        d_, i_ = _pq_probe_scan(
+    best_d, best_i = _chunked_over_queries(
+        lambda rq, pid: _pq_probe_scan(
             rq, pid,
             index.pq_codes, index.indices, index.list_sizes,
             k, is_ip, index.codebook_kind == CodebookGen.PER_CLUSTER,
             jnp.dtype(params.lut_dtype),
             pq_centers=index.pq_centers, centers_rot=centers_rot,
-        )
-        return d_, i_
-
-    if Q.shape[0] <= chunk:
-        best_d, best_i = run_chunk(rotq, probe_ids)
-    else:
-        outs = [run_chunk(rotq[s:s + chunk], probe_ids[s:s + chunk])
-                for s in range(0, Q.shape[0], chunk)]
-        best_d = jnp.concatenate([o[0] for o in outs], axis=0)
-        best_i = jnp.concatenate([o[1] for o in outs], axis=0)
+        ),
+        rotq, probe_ids, per_q)
     if index.metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i

@@ -8,6 +8,7 @@ used to shape launches and layouts.
 
 from raft_tpu.util.pow2 import Pow2, ceildiv, round_up_safe, round_down_safe, is_pow2
 from raft_tpu.util.itertools import product_of_lists
+from raft_tpu.util.input_validation import is_row_major, is_col_major
 
 __all__ = [
     "Pow2",
@@ -16,4 +17,6 @@ __all__ = [
     "round_down_safe",
     "is_pow2",
     "product_of_lists",
+    "is_row_major",
+    "is_col_major",
 ]

@@ -1,0 +1,30 @@
+"""Array layout/contiguity predicates.
+
+Ref: cpp/include/raft/util/input_validation.hpp — ``is_row_major`` /
+``is_col_major`` checks on mdspan layouts that public APIs assert on entry.
+JAX arrays are logically row-major (layout is XLA's concern), so these
+predicates inspect NumPy-visible strides when present and default to
+row-major for jax.Array inputs; kept so validation code ports 1:1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def is_row_major(x) -> bool:
+    """Ref: raft::is_row_major (util/input_validation.hpp). True for C
+    -contiguous host arrays and for all jax Arrays (logical row-major)."""
+    if isinstance(x, np.ndarray):
+        return x.flags["C_CONTIGUOUS"] or x.ndim <= 1
+    flags = getattr(x, "flags", None)
+    if isinstance(flags, dict):
+        return bool(flags.get("C_CONTIGUOUS", True))
+    return True
+
+
+def is_col_major(x) -> bool:
+    """Ref: raft::is_col_major."""
+    if isinstance(x, np.ndarray):
+        return x.flags["F_CONTIGUOUS"] or x.ndim <= 1
+    return getattr(x, "ndim", 2) <= 1
