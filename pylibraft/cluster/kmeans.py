@@ -189,7 +189,17 @@ def cluster_cost(X, centroids, handle=None):
 @auto_convert_output
 def fit(params: KMeansParams, X, centroids=None, sample_weights=None,
         handle=None):
-    """Ref cluster/kmeans.pyx:496 — returns (centroids, inertia, n_iter)."""
+    """Ref cluster/kmeans.pyx:496 — returns (centroids, inertia, n_iter).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from pylibraft.cluster.kmeans import KMeansParams, fit
+    >>> X = np.array([[0.0], [0.1], [10.0], [10.1]], np.float32)
+    >>> cen, inertia, n_iter = fit(KMeansParams(n_clusters=2, seed=0), X)
+    >>> [round(v, 2) for v in sorted(np.asarray(cen).ravel().tolist())]
+    [0.05, 10.05]
+    """
     x = cai_wrapper(X)
     c0 = None if centroids is None else cai_wrapper(centroids).array
     cen, inertia, n_iter = _impl.fit(

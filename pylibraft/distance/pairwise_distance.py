@@ -28,7 +28,18 @@ SUPPORTED_DISTANCES = [
 def distance(X, Y, out=None, metric="euclidean", p=2.0, handle=None):
     """Compute pairwise distances between X and Y; ref
     distance/pairwise_distance.pyx:93-171. ``out``, when given, receives the
-    result (host copy for numpy outputs) and is returned."""
+    result (host copy for numpy outputs) and is returned.
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from pylibraft.distance import pairwise_distance
+    >>> X = np.array([[0.0, 0.0], [3.0, 4.0]], np.float32)
+    >>> Y = np.array([[0.0, 0.0]], np.float32)
+    >>> np.asarray(pairwise_distance(X, Y, metric="euclidean")).round(2)
+    array([[0.],
+           [5.]], dtype=float32)
+    """
     if isinstance(metric, str):
         if metric not in DISTANCE_TYPES:
             raise ValueError(f"metric {metric!r} is not supported")

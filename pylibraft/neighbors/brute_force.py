@@ -22,7 +22,18 @@ def knn(dataset, queries, k=None, indices=None, distances=None,
         metric="sqeuclidean", metric_arg=2.0, global_id_offset=0,
         handle=None):
     """Exact nearest neighbors; returns ``(distances, indices)`` like the
-    reference (brute_force.pyx:179)."""
+    reference (brute_force.pyx:179).
+
+    Examples
+    --------
+    >>> import numpy as np
+    >>> from pylibraft.neighbors.brute_force import knn
+    >>> db = np.array([[0.0], [1.0], [5.0]], np.float32)
+    >>> q = np.array([[0.9]], np.float32)
+    >>> d, i = knn(db, q, k=2)
+    >>> np.asarray(i).tolist()
+    [[1, 0]]
+    """
     ds = cai_wrapper(dataset)
     q = cai_wrapper(queries)
     if k is None:

@@ -123,11 +123,11 @@ def check_vector(
 
 
 def is_row_major(x: Array) -> bool:
-    """Layout probe (ref: util/input_validation.hpp is_row_major). jax.Arrays
-    are always logically row-major; numpy arrays are checked for C order."""
-    if isinstance(x, np.ndarray):
-        return x.flags["C_CONTIGUOUS"] or x.ndim < 2
-    return True
+    """Layout probe (ref: util/input_validation.hpp is_row_major) —
+    delegates to the canonical predicate in util.input_validation."""
+    from raft_tpu.util.input_validation import is_row_major as _impl
+
+    return _impl(x)
 
 
 # -- factories (ref: make_device_matrix / make_device_vector /

@@ -1,0 +1,14 @@
+"""Deprecated alias of the sparse Lanczos eigensolver.
+
+Ref: cpp/include/raft/linalg/lanczos.cuh — a deprecation shim forwarding to
+``raft::sparse::solver`` (the reference moved Lanczos under sparse/solver
+and kept this header for source compatibility; SURVEY.md §2.3). Import from
+:mod:`raft_tpu.sparse.solver.lanczos` in new code.
+"""
+
+from raft_tpu.sparse.solver.lanczos import (  # noqa: F401
+    lanczos_largest_eigenpairs,
+    lanczos_smallest_eigenpairs,
+)
+
+__all__ = ["lanczos_smallest_eigenpairs", "lanczos_largest_eigenpairs"]

@@ -15,11 +15,12 @@ from raft_tpu.matrix.ops import (
     col_wise_sort,
     triangular_upper,
     shift_fill,
+    l2_norm,
 )
 from raft_tpu.matrix.select_k import select_k, SelectMethod
 
 __all__ = [
     "argmax", "argmin", "gather", "gather_if", "scatter", "slice_", "copy",
     "init", "reverse", "sign_flip", "linewise_op", "col_wise_sort",
-    "triangular_upper", "shift_fill", "select_k", "SelectMethod",
+    "triangular_upper", "shift_fill", "l2_norm", "select_k", "SelectMethod",
 ]

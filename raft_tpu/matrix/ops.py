@@ -120,3 +120,10 @@ def shift_fill(matrix, k: int, fill_value=0.0):
     col = jnp.arange(n)[None, :]
     vacated = col < k if k >= 0 else col >= n + k
     return jnp.where(vacated, jnp.asarray(fill_value, m.dtype), shifted)
+
+
+def l2_norm(x) -> jax.Array:
+    """Frobenius/L2 norm of the whole matrix (ref: raft::matrix::l2_norm,
+    matrix/norm.cuh:36)."""
+    x = as_array(x)
+    return jnp.sqrt(jnp.sum(x.astype(jnp.float32) ** 2))

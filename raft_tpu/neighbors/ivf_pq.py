@@ -47,7 +47,12 @@ from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
-from raft_tpu.neighbors.ivf_flat import _pack_lists
+from raft_tpu.neighbors.ivf_flat import (
+    _bucketed_probe_scan,
+    _chunked_over_queries,
+    _pack_lists,
+    _pick_engine,
+)
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv
 
@@ -622,8 +627,6 @@ def search(
     rot = index.rotation_matrix
     rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
 
-    from raft_tpu.neighbors.ivf_flat import _bucketed_probe_scan, _pick_engine
-
     # "auto" only switches to the recon-cache engine when the LUT dtype
     # knobs are at their defaults — an explicit lut_dtype/internal dtype
     # request is honored by the LUT scan path (an explicit
@@ -654,8 +657,6 @@ def search(
     # gathered codes plus a (q_chunk, pq_dim, book) LUT per probe step —
     # unchunked at cap=2048, pq_dim=64 a 1000-query batch is ~0.5 GB of
     # gather per step (enough to take down the worker at 1M scale).
-    from raft_tpu.neighbors.ivf_flat import _chunked_over_queries
-
     cap = index.pq_codes.shape[1]
     per_q = max(cap * index.pq_dim * 4, index.pq_dim * 256 * 4)
     best_d, best_i = _chunked_over_queries(

@@ -1,10 +1,10 @@
-"""Array layout/contiguity predicates.
+"""Array layout/contiguity predicates — the canonical implementation.
 
 Ref: cpp/include/raft/util/input_validation.hpp — ``is_row_major`` /
 ``is_col_major`` checks on mdspan layouts that public APIs assert on entry.
-JAX arrays are logically row-major (layout is XLA's concern), so these
-predicates inspect NumPy-visible strides when present and default to
-row-major for jax.Array inputs; kept so validation code ports 1:1.
+JAX arrays are logically row-major (layout is XLA's concern); NumPy arrays
+are checked via flags, and host wrapper objects via a ``flags`` dict when
+they expose one. ``raft_tpu.core.mdarray.is_row_major`` delegates here.
 """
 
 from __future__ import annotations
@@ -12,19 +12,22 @@ from __future__ import annotations
 import numpy as np
 
 
+def _flag(x, name: str, default: bool) -> bool:
+    if isinstance(x, np.ndarray):
+        return bool(x.flags[name]) or x.ndim <= 1
+    flags = getattr(x, "flags", None)
+    if isinstance(flags, dict) and name in flags:
+        return bool(flags[name]) or getattr(x, "ndim", 2) <= 1
+    return default
+
+
 def is_row_major(x) -> bool:
     """Ref: raft::is_row_major (util/input_validation.hpp). True for C
     -contiguous host arrays and for all jax Arrays (logical row-major)."""
-    if isinstance(x, np.ndarray):
-        return x.flags["C_CONTIGUOUS"] or x.ndim <= 1
-    flags = getattr(x, "flags", None)
-    if isinstance(flags, dict):
-        return bool(flags.get("C_CONTIGUOUS", True))
-    return True
+    return _flag(x, "C_CONTIGUOUS", True)
 
 
 def is_col_major(x) -> bool:
-    """Ref: raft::is_col_major."""
-    if isinstance(x, np.ndarray):
-        return x.flags["F_CONTIGUOUS"] or x.ndim <= 1
-    return getattr(x, "ndim", 2) <= 1
+    """Ref: raft::is_col_major. jax Arrays report column-major only when
+    one-dimensional (degenerate layouts are both)."""
+    return _flag(x, "F_CONTIGUOUS", getattr(x, "ndim", 2) <= 1)

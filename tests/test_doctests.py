@@ -1,0 +1,26 @@
+"""Run all pylibraft API docstring examples.
+
+Ref: python/pylibraft/pylibraft/test/test_doctests.py — the reference
+collects doctests from every public pylibraft module and executes them.
+"""
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import pylibraft
+
+_MODULES = sorted(
+    m.name
+    for m in pkgutil.walk_packages(pylibraft.__path__, prefix="pylibraft.")
+    if not m.ispkg
+)
+
+
+@pytest.mark.parametrize("modname", _MODULES)
+def test_module_doctests(modname):
+    mod = importlib.import_module(modname)
+    results = doctest.testmod(mod, verbose=False)
+    assert results.failed == 0, f"{results.failed} doctest failures in {modname}"
