@@ -24,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from raft_tpu.core.error import expects
+from raft_tpu.util.input_validation import is_row_major as _is_row_major_impl
 
 Array = Union[jax.Array, np.ndarray]
 
@@ -125,9 +126,7 @@ def check_vector(
 def is_row_major(x: Array) -> bool:
     """Layout probe (ref: util/input_validation.hpp is_row_major) —
     delegates to the canonical predicate in util.input_validation."""
-    from raft_tpu.util.input_validation import is_row_major as _impl
-
-    return _impl(x)
+    return _is_row_major_impl(x)
 
 
 # -- factories (ref: make_device_matrix / make_device_vector /

@@ -36,6 +36,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import logger
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.cluster import kmeans_balanced
@@ -335,6 +336,11 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     if engine == "bucketed" and cap_q == 0:
         mean_load = max(1, (n_queries * n_probes) // n_lists)
         cap_q = min(n_queries, 8 * ceildiv(4 * mean_load, 8))
+    # Debug log at the dispatch decision, like the reference's
+    # RAFT_LOG_DEBUG at perf-relevant branches (SURVEY.md §5).
+    logger.debug(
+        "ivf search dispatch: engine=%s q=%d probes=%d lists=%d k=%d cap_q=%d",
+        engine, n_queries, n_probes, n_lists, k, cap_q)
     return engine, cap_q
 
 

@@ -12,11 +12,21 @@ import pytest
 
 import pylibraft
 
+_IMPORT_ERRORS = []
+
 _MODULES = sorted(
     m.name
-    for m in pkgutil.walk_packages(pylibraft.__path__, prefix="pylibraft.")
+    for m in pkgutil.walk_packages(pylibraft.__path__, prefix="pylibraft.",
+                                   onerror=_IMPORT_ERRORS.append)
     if not m.ispkg
 )
+
+
+def test_all_packages_walkable():
+    """A broken subpackage must fail loudly, not silently drop its modules
+    from the grid."""
+    assert not _IMPORT_ERRORS, f"unimportable pylibraft packages: {_IMPORT_ERRORS}"
+    assert len(_MODULES) >= 16  # current module count; shrink = lost coverage
 
 
 @pytest.mark.parametrize("modname", _MODULES)
