@@ -211,3 +211,20 @@ def test_refine_host_matches_device(rng):
     dd, di = refine(ds, q, cand, 5)
     np.testing.assert_array_equal(hi, np.asarray(di))
     np.testing.assert_allclose(hd, np.asarray(dd), rtol=1e-4)
+
+
+def test_ivf_flat_uint8_native_storage(rng, tmp_path):
+    """u8 datasets stay u8 in the index, through serialization, and search
+    exactly like the f32 path (ref: the int8/uint8 native input paths,
+    loadAndComputeDist<int8>, detail/ivf_flat_search.cuh:456)."""
+    db = rng.integers(0, 256, size=(1500, 16)).astype(np.uint8)
+    Q = rng.integers(0, 256, size=(50, 16)).astype(np.uint8)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4),
+                         db)
+    assert idx.data.dtype == np.uint8
+    ed, ei = brute_force.knn(db.astype(np.float32), Q.astype(np.float32), 5)
+    d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=8), idx, Q, 5)
+    assert _recall(np.asarray(i), np.asarray(ei)) > 0.999
+    path = str(tmp_path / "idx_u8.npz")
+    ivf_flat.save(path, idx)
+    assert ivf_flat.load(path).data.dtype == np.uint8
