@@ -16,9 +16,14 @@ TPU-native re-design:
   "adjust centers" pass re-seeds empty/underweight clusters from the
   highest-cost samples — expressed with sorts/masks instead of the
   reference's atomics-based kernel;
-* hierarchical build orchestrates per-mesocluster sub-problems on the host
-  (build-time path), each sub-fit jit-compiled — mirroring the reference's
-  host loop over mesoclusters (build_hierarchical).
+* hierarchical build runs the fine-cluster stage as a single *masked*
+  balanced EM: every fine centroid is owned by one mesocluster and the
+  assignment step only considers centroids owned by the sample's
+  mesocluster. Ownership masking decouples the EM into exactly the
+  per-mesocluster sub-problems of the reference's ``build_hierarchical``
+  host loop — but as ONE jitted program with O(1) host round-trips
+  instead of O(mesoclusters) device calls (the round-1 build spent
+  ~520 s in host-orchestrated sub-fits over a ~100 ms-RTT device link).
 
 Integer dtypes (SIFT-style uint8/int8) are accepted and mapped to float32
 on entry, the role of ``utils::mapping<T>`` in the reference.
@@ -41,6 +46,7 @@ from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
+from raft_tpu.util.pow2 import ceildiv
 
 # Threshold ratio below which a cluster is considered under-populated and
 # eligible for re-seeding (ref: adjust_centers uses average/4 as the small-
@@ -55,51 +61,48 @@ def _as_float(x) -> jax.Array:
     return x
 
 
+def _labels(X, centroids, metric: DistanceType) -> jax.Array:
+    """Metric-dispatched nearest-centroid labels (ref: predict_core:83):
+    fused L2+argmin for the L2 family, pairwise + argmin/argmax otherwise."""
+    if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
+        _, labels = fused_l2_nn_min_reduce(X, centroids)
+        return labels
+    from raft_tpu.distance.distance_types import is_min_close
+
+    d = pairwise_distance_fn(X, centroids, metric=metric)
+    return (jnp.argmin(d, axis=1) if is_min_close(metric)
+            else jnp.argmax(d, axis=1)).astype(jnp.int32)
+
+
 def predict(
     params: KMeansBalancedParams, centroids, X
 ) -> jax.Array:
     """Nearest-centroid labels (ref: kmeans_balanced::predict,
     cluster/kmeans_balanced.cuh:133 → predict_core:83)."""
-    X = _as_float(X)
-    centroids = _as_float(centroids)
-    if params.metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
-        _, labels = fused_l2_nn_min_reduce(X, centroids)
-        return labels
-    d = pairwise_distance_fn(X, centroids, metric=params.metric)
-    from raft_tpu.distance.distance_types import is_min_close
-
-    if is_min_close(params.metric):
-        return jnp.argmin(d, axis=1).astype(jnp.int32)
-    return jnp.argmax(d, axis=1).astype(jnp.int32)
+    return _labels(_as_float(X), _as_float(centroids), params.metric)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4))
-def _balanced_em_weighted(X, w, centroids0, n_iters: int, n_clusters: int):
-    """Balancing EM (ref: balancing_em_iters, detail/kmeans_balanced.cuh:616)
-    with a per-row validity weight ``w`` (1 real / 0 padding) so callers can
-    pad the row dimension to shared compile shapes — each iteration assigns,
-    recomputes weighted means, then re-seeds under-populated clusters from
-    the highest-cost real samples (adjust_centers:522)."""
-    n = X.shape[0]
-    n_valid = jnp.sum(w)
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _balanced_em(X, centroids0, n_iters: int, n_clusters: int):
+    """Balancing EM (ref: balancing_em_iters, detail/kmeans_balanced.cuh:616):
+    each iteration assigns, recomputes means, then re-seeds under-populated
+    clusters from the highest-cost samples (adjust_centers:522)."""
     threshold = jnp.maximum(
         jnp.asarray(1.0, X.dtype),
-        (_SMALL_RATIO * n_valid / n_clusters).astype(X.dtype))
+        jnp.asarray(_SMALL_RATIO * X.shape[0] / n_clusters, X.dtype))
 
     def body(_, centroids):
         dists, labels = fused_l2_nn_min_reduce(X, centroids)
-        sums = jax.ops.segment_sum(X * w[:, None], labels,
-                                   num_segments=n_clusters)
-        counts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+        sums = jax.ops.segment_sum(X, labels, num_segments=n_clusters)
+        counts = jax.ops.segment_sum(
+            jnp.ones((X.shape[0],), X.dtype), labels, num_segments=n_clusters)
         new = sums / jnp.maximum(counts, 1.0)[:, None]
         new = jnp.where((counts > 0)[:, None], new, centroids)
 
         # adjust_centers: rank clusters by population; rank samples by cost.
         # The i-th most under-populated cluster is re-seeded to the i-th
         # highest-cost sample (a deterministic variant of the reference's
-        # probabilistic pick from high-cost samples). Padding rows carry
-        # -inf cost so they are never picked as seeds.
-        dists = jnp.where(w > 0, dists, -jnp.inf)
+        # probabilistic pick from high-cost samples).
         order = jnp.argsort(counts)                      # ascending population
         rank = jnp.argsort(order)                        # cluster -> its rank
         n_small = jnp.sum(counts < threshold)
@@ -111,31 +114,153 @@ def _balanced_em_weighted(X, w, centroids0, n_iters: int, n_clusters: int):
     return lax.fori_loop(0, n_iters, body, centroids0)
 
 
-def _balanced_em(X, centroids0, n_iters: int, n_clusters: int):
-    return _balanced_em_weighted(
-        X, jnp.ones((X.shape[0],), X.dtype), centroids0, n_iters, n_clusters)
+@functools.partial(jax.jit, static_argnums=(2,))
+def _predict_and_count(X, centroids, metric: DistanceType):
+    """Labels + per-cluster populations in one device call."""
+    labels = _labels(X, centroids, metric)
+    counts = jax.ops.segment_sum(
+        jnp.ones((X.shape[0],), jnp.int32), labels,
+        num_segments=centroids.shape[0])
+    return labels, counts
 
 
-def _host_kmeans_pp_seed(X: np.ndarray, k: int, rng) -> np.ndarray:
-    """k-means++ seeding on the host (NumPy) — used for the hierarchical
-    sub-fits so good seeds don't cost one device compilation per sub-fit
-    shape (ref: the same D²-sampling as kmeansPlusPlus,
-    cluster/detail/kmeans.cuh:~120)."""
-    n = X.shape[0]
-    seeds = np.empty((k, X.shape[1]), X.dtype)
-    seeds[0] = X[rng.integers(n)]
-    d2 = ((X - seeds[0]) ** 2).sum(1)
-    for i in range(1, k):
-        total = d2.sum()
-        if total <= 0:
-            # Fewer distinct points than seeds (duplicate-heavy data):
-            # remaining seeds sample uniformly, matching the reference's
-            # degenerate-trainset behavior.
-            seeds[i:] = X[rng.integers(n, size=k - i)]
-            break
-        seeds[i] = X[rng.choice(n, p=d2 / total)]
-        d2 = np.minimum(d2, ((X - seeds[i]) ** 2).sum(1))
-    return seeds
+# Row-block / centroid-tile caps for the masked assignment scan: the
+# materialized distance tile is (block, ktile) f32 = 512 MB max, whatever
+# n and n_clusters are. Small problems clamp both to their own size.
+_ASSIGN_BLOCK = 65536
+_ASSIGN_KTILE = 2048
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6))
+def _hierarchical_fine_em(X, meso_labels, owner, seed_slots, key,
+                          n_iters: int, n_clusters: int):
+    """Fine-cluster stage of ``build_hierarchical`` as one jitted program.
+
+    Ref: detail/kmeans_balanced.cuh build_hierarchical — the reference loops
+    over mesoclusters on the host, gathering each mesocluster's members and
+    running ``build_clusters`` on them. Here the same sub-problems run
+    simultaneously:
+
+    * seeding is a *masked k-means++*: cost-weight sampling (Gumbel trick +
+      per-group segment-argmax) of each mesocluster's rank-r seed, one
+      round per rank — every group picks its r-th seed in the same O(n·d)
+      sweep, so the whole seeding costs max-quota passes over X instead of
+      k — ≈O(√k) when mesocluster populations are balanced (which the
+      balancing meso EM maintains; adversarial skew degrades towards O(k),
+      the price of exact per-group D² sequencing). Within a group the
+      picks are sequential in r, which is
+      the D²-sampling of kmeansPlusPlus restricted per group (the first
+      seed of each group falls out as a uniform pick, all costs starting
+      equal);
+    * the EM assignment adds an ownership mask (centroid j is only visible
+      to samples whose mesocluster is ``owner[j]``), which makes the joint
+      EM decompose into the reference's independent per-mesocluster fits
+      while staying a single static-shape XLA program. The fine EM runs
+      plain masked Lloyd iterations; under-population repair
+      (adjust_centers) is deferred to the unmasked final polish —
+      measured recall/balance on 1M clustered rows matches the per-subfit
+      reseeding it replaces (BASELINE.md).
+
+    ``owner`` is (n_clusters,) int32: the owning mesocluster of each fine
+    centroid. ``seed_slots`` is (max_quota, n_meso) int32: the fine-centroid
+    id of mesocluster m's rank-r seed, or -1 past m's quota. Assignment
+    scans row blocks × centroid tiles so the live distance tile is bounded
+    regardless of n and n_clusters.
+    """
+    n, d = X.shape
+    n_meso = seed_slots.shape[1]
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    # --- masked k-means++ seeding, one round per quota rank
+    def seed_round(r, carry):
+        seeds, mind = carry
+        slot = seed_slots[r]                             # (n_meso,)
+        valid = slot >= 0
+        z = (jnp.log(jnp.maximum(mind, 1e-12))
+             + jax.random.gumbel(jax.random.fold_in(key, r), (n,), X.dtype))
+        segmax = jax.ops.segment_max(z, meso_labels, num_segments=n_meso)
+        cand = jnp.where(z == segmax[meso_labels], rows, n)
+        pick = jnp.clip(
+            jax.ops.segment_min(cand, meso_labels, num_segments=n_meso),
+            0, n - 1)                                    # (n_meso,)
+        S = X[pick]                                      # (n_meso, d)
+        seeds = seeds.at[jnp.where(valid, slot, n_clusters)].set(
+            S, mode="drop")
+        dnew = jnp.sum((X - S[meso_labels]) ** 2, axis=1)
+        upd = valid[meso_labels]
+        return seeds, jnp.where(upd, jnp.minimum(mind, dnew), mind)
+
+    centroids0, _ = lax.fori_loop(
+        0, seed_slots.shape[0], seed_round,
+        (jnp.zeros((n_clusters, d), X.dtype),
+         jnp.full((n,), jnp.asarray(1e30, X.dtype))))
+
+    # --- masked balanced EM (row-blocked × centroid-tiled assignment)
+    block = min(_ASSIGN_BLOCK, ceildiv(n, 256) * 256)
+    ktile = min(_ASSIGN_KTILE, ceildiv(n_clusters, 256) * 256)
+
+    nb = ceildiv(n, block)
+    pad = nb * block - n
+    Xp = jnp.concatenate([X, jnp.zeros((pad, d), X.dtype)]) if pad else X
+    gp = (jnp.concatenate([meso_labels,
+                           jnp.full((pad,), -1, meso_labels.dtype)])
+          if pad else meso_labels)
+    Xb = Xp.reshape(nb, block, d)
+    gb = gp.reshape(nb, block)
+    w = (gp >= 0).astype(X.dtype)
+
+    nkt = ceildiv(n_clusters, ktile)
+    padk = nkt * ktile - n_clusters
+    owner_p = (jnp.concatenate([owner, jnp.full((padk,), -2, owner.dtype)])
+               if padk else owner)
+    ow_tiles = owner_p.reshape(nkt, ktile)
+
+    def assign(C):
+        Cp = (jnp.concatenate([C, jnp.zeros((padk, d), C.dtype)])
+              if padk else C)
+        c_tiles = Cp.reshape(nkt, ktile, d)
+        cn_tiles = jnp.sum(c_tiles * c_tiles, axis=2)
+
+        def blk(_, inp):
+            xb, grp = inp
+            xn = jnp.sum(xb * xb, axis=1)
+
+            def ctile(carry, tile):
+                best_d, best_i, base = carry
+                Ct, cnt, owt = tile
+                # Same expanded-L2 + running-argmin scheme as
+                # fused_l2_nn_min_reduce, with the ownership mask folded in
+                # before the argmin (the shared helper has no mask hook).
+                dtile = jnp.maximum(
+                    xn[:, None] + cnt[None, :]
+                    - 2.0 * jnp.matmul(xb, Ct.T), 0.0)
+                dtile = jnp.where(owt[None, :] == grp[:, None], dtile,
+                                  jnp.inf)
+                ti = jnp.argmin(dtile, axis=1).astype(jnp.int32)
+                td = jnp.take_along_axis(dtile, ti[:, None], axis=1)[:, 0]
+                upd = td < best_d
+                return (jnp.where(upd, td, best_d),
+                        jnp.where(upd, ti + base, best_i),
+                        base + ktile), None
+
+            init = (jnp.full((xb.shape[0],), jnp.inf, X.dtype),
+                    jnp.zeros((xb.shape[0],), jnp.int32), jnp.int32(0))
+            (_, bi, _), _ = lax.scan(ctile, init,
+                                     (c_tiles, cn_tiles, ow_tiles))
+            return 0, bi
+
+        _, lab = lax.scan(blk, 0, (Xb, gb))
+        return lab.reshape(-1)
+
+    def body(_, C):
+        labels = assign(C)
+        sums = jax.ops.segment_sum(Xp * w[:, None], labels,
+                                   num_segments=n_clusters)
+        cnts = jax.ops.segment_sum(w, labels, num_segments=n_clusters)
+        new = sums / jnp.maximum(cnts, 1.0)[:, None]
+        return jnp.where((cnts > 0)[:, None], new, C)
+
+    return lax.fori_loop(0, n_iters, body, centroids0)
 
 
 def build_clusters(
@@ -181,14 +306,17 @@ def fit(
     if n_clusters <= 256 or n < 4 * n_clusters:
         return build_clusters(params, X, n_clusters)
 
-    # Hierarchical: mesoclusters then split (host-orchestrated build path).
+    # Hierarchical: mesoclusters, then a masked fine EM (device-resident).
+    # Host↔device traffic for the whole build: ONE (n_meso,)-int transfer
+    # (the mesocluster populations, to compute the static quota split).
     n_meso = int(math.ceil(math.sqrt(n_clusters)))
     meso_params = KMeansBalancedParams(
         n_iters=params.n_iters, metric=params.metric, rng_state=params.rng_state
     )
     meso_centroids = build_clusters(meso_params, X, n_meso)
-    meso_labels = np.asarray(predict(meso_params, meso_centroids, X))
-    counts = np.bincount(meso_labels, minlength=n_meso)
+    meso_labels, counts_dev = _predict_and_count(X, meso_centroids,
+                                                 params.metric)
+    counts = np.asarray(counts_dev)
 
     # Fine-cluster quota per mesocluster ∝ population (ref: build_hierarchical
     # computes fine_clusters_nums proportional to mesocluster sizes).
@@ -199,46 +327,21 @@ def fit(
         cand = np.where(quota > 1)[0]
         quota[cand[np.argmin(counts[cand] / quota[cand])]] -= 1
 
-    Xh = np.asarray(X)
-    fine = []
-    for m in range(n_meso):
-        members = Xh[meso_labels == m]
-        km = int(quota[m])
-        if len(members) == 0:
-            fine.append(np.zeros((km, d), Xh.dtype))
-            continue
-        if len(members) <= km:
-            # Degenerate: pad by repeating members.
-            reps = np.resize(members, (km, d))
-            fine.append(reps)
-            continue
-        # Pad rows to a power-of-two bucket with zero weights so the 32-odd
-        # sub-fits share a handful of compile shapes instead of one XLA
-        # compilation each (the dominant cost of build_hierarchical over a
-        # high-latency device link). Seeding stays on the real rows — k++
-        # on the host for small km (build_clusters' km<=64 rule: strided
-        # seeds hit the merged-blob local optimum), strided otherwise.
-        nv = len(members)
-        npad = max(64, 1 << (nv - 1).bit_length())
-        pad_rows = npad - nv
-        Xp = np.concatenate(
-            [members, np.zeros((pad_rows, d), Xh.dtype)]) if pad_rows else members
-        wp = np.zeros((npad,), Xh.dtype)
-        wp[:nv] = 1.0
-        if km <= 64:
-            c0 = _host_kmeans_pp_seed(members, km,
-                                      np.random.default_rng(1000 + m))
-        else:
-            stride = max(nv // km, 1)
-            c0 = members[::stride][:km]
-            if len(c0) < km:
-                c0 = np.resize(members, (km, d))
-        sub = _balanced_em_weighted(jnp.asarray(Xp), jnp.asarray(wp),
-                                    jnp.asarray(c0), params.n_iters, km)
-        fine.append(np.asarray(sub))
-    centroids = jnp.asarray(np.concatenate(fine, axis=0))
+    owner_h = np.repeat(np.arange(n_meso), quota).astype(np.int32)
+    rank_h = np.concatenate([np.arange(q) for q in quota]).astype(np.int32)
+    # Round the round count up to a power of two so repeat builds with
+    # slightly different quota skew reuse one XLA compilation (extra rounds
+    # are all -1 slots, skipped by the valid mask).
+    max_q = 1 << (int(quota.max()) - 1).bit_length()
+    seed_slots = np.full((max_q, n_meso), -1, np.int32)
+    seed_slots[rank_h, owner_h] = np.arange(n_clusters, dtype=np.int32)
+    centroids = _hierarchical_fine_em(
+        X, meso_labels, jnp.asarray(owner_h), jnp.asarray(seed_slots),
+        params.rng_state.next_key(), params.n_iters, n_clusters)
 
-    # Final polish over the full dataset.
+    # Final polish over the full dataset (drops the ownership constraint and
+    # re-seeds under-populated clusters — the role of the reference's trailing
+    # balancing_em_iters over the full fine set).
     return _balanced_em(X, centroids, max(2, params.n_iters // 2), n_clusters)
 
 
