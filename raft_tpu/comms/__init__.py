@@ -11,6 +11,8 @@ from raft_tpu.comms.comms import (
 )
 from raft_tpu.comms.comms_test import (
     test_collective_allreduce,
+    test_collective_allreduce_prod,
+    test_collective_gatherv,
     test_collective_broadcast,
     test_collective_reduce,
     test_collective_allgather,
@@ -22,7 +24,8 @@ from raft_tpu.comms.comms_test import (
 __all__ = [
     "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
     "inject_comms_on_handle",
-    "test_collective_allreduce", "test_collective_broadcast",
+    "test_collective_allreduce", "test_collective_allreduce_prod",
+    "test_collective_gatherv", "test_collective_broadcast",
     "test_collective_reduce", "test_collective_allgather",
     "test_collective_reducescatter", "test_pointToPoint_simple_send_recv",
     "test_commsplit",

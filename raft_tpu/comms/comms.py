@@ -104,13 +104,28 @@ class Comms:
 
     def sync_stream(self, *arrays) -> StatusT:
         """Ref: comms_t::sync_stream (status-returning async-error probe,
-        core/comms.hpp:290)."""
+        core/comms.hpp:290). Cooperative cancellation surfaces as ABORT —
+        the role of the reference's ncclCommAbort-triggered status — while
+        XLA/collective failures surface as ERROR."""
+        from raft_tpu.core.interruptible import InterruptedException
+
         try:
             for a in arrays:
                 jax.block_until_ready(a)
             return StatusT.SUCCESS
+        except (InterruptedException, KeyboardInterrupt):
+            return StatusT.ABORT
         except Exception:  # XLA surfaces collective failures as exceptions
             return StatusT.ERROR
+
+    def group_start(self) -> None:
+        """Ref: comms_t::group_start (→ ncclGroupStart). The reference
+        batches collective launches to avoid deadlock/serialization; XLA
+        schedules all collectives of a compiled program jointly, so the
+        grouping is implicit — kept as a no-op for API parity."""
+
+    def group_end(self) -> None:
+        """Ref: comms_t::group_end (→ ncclGroupEnd). See group_start."""
 
     # -- collectives (call inside shard_map) -------------------------------
     def allreduce(self, x, op: OpT = OpT.SUM):
@@ -122,7 +137,13 @@ class Comms:
         if op == OpT.MAX:
             return lax.pmax(x, self.axis)
         if op == OpT.PROD:
-            return jnp.exp(lax.psum(jnp.log(x), self.axis))
+            # Exact elementwise product across ranks: gather the rank values
+            # and multiply. (A log/exp psum trick would NaN on negatives and
+            # lose zeros; all_gather+prod preserves sign/zero semantics of
+            # ncclProd exactly. Product reductions are rare and small, so
+            # the size-x traffic of the gather is acceptable.)
+            stacked = lax.all_gather(x, self.axis)  # (size, ...)
+            return jnp.prod(stacked, axis=0)
         raise ValueError(op)
 
     def allgather(self, x, axis: int = 0, tiled: bool = True):
@@ -158,9 +179,26 @@ class Comms:
                                 tiled=True)
 
     def gather(self, x, root: int = 0, axis: int = 0):
-        """Ref: comms_t::gather. Symmetric all_gather; caller uses the root's
-        view (XLA has no asymmetric gather — the data lands everywhere)."""
-        return lax.all_gather(x, self.axis, axis=axis, tiled=True)
+        """Ref: comms_t::gather. SPMD XLA has no asymmetric gather — the
+        all_gather traffic lands everywhere — but the *contract* is rooted:
+        non-root ranks get zeros so callers cannot accidentally depend on
+        data the reference leaves unspecified off-root."""
+        full = lax.all_gather(x, self.axis, axis=axis, tiled=True)
+        return jnp.where(lax.axis_index(self.axis) == root, full,
+                         jnp.zeros_like(full))
+
+    def gatherv(self, x, count, root: int = 0, axis: int = 0):
+        """Ref: comms_t::gatherv (core/comms.hpp:200-240) — root receives a
+        variable-length shard from each rank. Under static shapes each rank
+        sends its padded shard plus its valid ``count``; the root gets
+        ``(stacked (size, pad, ...), counts (size,))`` and masks/compacts.
+        Root-only semantics: non-root ranks receive zeros (see ``gather``).
+        """
+        stacked = lax.all_gather(x, self.axis, axis=axis, tiled=False)
+        counts = lax.all_gather(count, self.axis)
+        is_root = lax.axis_index(self.axis) == root
+        return (jnp.where(is_root, stacked, jnp.zeros_like(stacked)),
+                jnp.where(is_root, counts, jnp.zeros_like(counts)))
 
     def device_sendrecv(self, x, dest: int, source: int):
         """Paired send/recv (ref: comms_t::device_sendrecv,

@@ -41,6 +41,59 @@ def test_collective_allreduce(mesh: Mesh, axis: str = "data") -> bool:
     return bool(np.all(np.asarray(out) == n))
 
 
+def test_collective_allreduce_prod(mesh: Mesh, axis: str = "data") -> bool:
+    """PROD with negatives and a zero lane: rank r contributes
+    [-(r+2), r==0 ? 0 : 1], so lane 0 must be (-1)^n * (n+1)!/1! and lane 1
+    must be 0 (sign/zero semantics of ncclProd)."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+
+    def body(x):
+        r = comms.get_rank()
+        mine = jnp.stack([-(r.astype(jnp.float32) + 2.0),
+                          jnp.where(r == 0, 0.0, 1.0)])
+        return comms.allreduce(mine, op=OpT.PROD)[None]
+
+    out = np.asarray(_run(mesh, axis, body, (P(axis),), P(axis, None),
+                          jnp.zeros((n,), jnp.float32)))
+    expect0 = ((-1.0) ** n) * np.prod(np.arange(2, n + 2, dtype=np.float64))
+    return bool(np.allclose(out[:, 0], expect0) and np.all(out[:, 1] == 0.0))
+
+
+def test_collective_gatherv(mesh: Mesh, axis: str = "data",
+                            root: int = 0) -> bool:
+    """Rooted variable-count gather: rank r sends r+1 valid values (padded
+    to the max); root must see every shard with its count, non-root must
+    see zeros (ref: test_collective_gatherv, comms/detail/test.hpp)."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+    pad = n  # max count
+
+    def body(x):
+        r = comms.get_rank()
+        cnt = r + 1
+        mine = jnp.where(jnp.arange(pad) < cnt,
+                         r.astype(jnp.float32) + 10.0, 0.0)
+        shards, counts = comms.gatherv(mine, cnt[None], root=root)
+        return shards.reshape(-1)[None], counts.reshape(-1)[None]
+
+    shards, counts = _run(mesh, axis, body, (P(axis),),
+                          (P(axis, None), P(axis, None)),
+                          jnp.zeros((n,), jnp.float32))
+    shards = np.asarray(shards).reshape(n, n, pad)
+    counts = np.asarray(counts).reshape(n, n)
+    for rk in range(n):
+        if rk == root:
+            for src in range(n):
+                c = src + 1
+                if not (np.all(shards[rk, src, :c] == src + 10.0)
+                        and counts[rk, src] == c):
+                    return False
+        elif shards[rk].any() or counts[rk].any():
+            return False
+    return True
+
+
 def test_collective_broadcast(mesh: Mesh, axis: str = "data", root: int = 0) -> bool:
     """Root's value must land on every rank (ref: test_collective_bcast)."""
     n = mesh.shape[axis]

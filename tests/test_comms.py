@@ -30,6 +30,12 @@ class TestCollectives:
     def test_allreduce(self, mesh):
         assert comms_mod.test_collective_allreduce(mesh)
 
+    def test_allreduce_prod(self, mesh):
+        assert comms_mod.test_collective_allreduce_prod(mesh)
+
+    def test_gatherv(self, mesh):
+        assert comms_mod.test_collective_gatherv(mesh)
+
     def test_broadcast(self, mesh):
         assert comms_mod.test_collective_broadcast(mesh)
 
