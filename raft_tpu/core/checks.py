@@ -27,9 +27,10 @@ from jax.experimental import checkify
 def checked(fn: Callable, errors=None) -> Callable:
     """Wrap ``fn`` so checkify errors raise on the host.
 
-    ``errors`` defaults to float (NaN/Inf), index OOB, and division checks —
-    the traced-code analog of RAFT_EXPECTS preconditions. The wrapped
-    function stays jittable (checkify functionalizes the assertions).
+    ``errors`` defaults to float (NaN/Inf), index OOB, division, and user
+    checks (so explicit ``check()`` calls surface too) — the traced-code
+    analog of RAFT_EXPECTS preconditions. The wrapped function stays
+    jittable (checkify functionalizes the assertions).
     """
     if errors is None:
         errors = (checkify.float_checks | checkify.index_checks

@@ -72,14 +72,20 @@ class SearchParams:
     gather path (exact probe coverage). "bucketed" inverts the probe map —
     per list, the queries probing it are batched and scored with one MXU
     matmul (the query-grouping of calc_chunk_indices,
-    detail/ivf_pq_search.cuh:267, turned into dense tiles). Lists probed by
-    more than ``bucket_cap`` queries drop the *farthest-rank* probes of the
-    excess queries — bounded, documented approximation on top of an already
-    approximate index. "auto" picks bucketed on TPU when the probe load
+    detail/ivf_pq_search.cuh:267, turned into dense tiles). When a list is
+    probed by more than ``bucket_cap`` queries, the excess (query, probe)
+    pairs are dropped best-centroid-rank-kept *per list* — under hot-list
+    contention an explicit low capacity can therefore cost a query even
+    its best-ranked probe. "auto" sizes the capacity from the measured
+    best-half-rank contention (one jitted scalar device read), which
+    guarantees only rank ≥ n_probes/2 probes of contended lists ever drop,
+    and falls back to "scan" when that capacity would exceed the bucket
+    memory budget; it picks bucketed on TPU when the probe load
     q·n_probes/n_lists is high enough to fill tiles.
 
-    ``bucket_cap``: per-list query-slot capacity for "bucketed"; 0 = auto
-    (4× the mean probe load, rounded up to 8).
+    ``bucket_cap``: per-list query-slot capacity for "bucketed"; 0 = the
+    measured sizing above. Set explicitly to skip the measurement and
+    accept drops at that capacity.
     """
 
     n_probes: int = 20
@@ -310,32 +316,84 @@ def _chunked_over_queries(fn, Q, probe_ids, per_q_bytes: int,
     sized so the per-chunk probe workspace stays under ``budget`` bytes —
     shared by both scan engines (their per-probe gather is
     O(q_chunk · per_q_bytes))."""
-    chunk = max(1, min(Q.shape[0], budget // max(per_q_bytes, 1)))
-    if Q.shape[0] <= chunk:
+    nq = Q.shape[0]
+    chunk = max(1, min(nq, budget // max(per_q_bytes, 1)))
+    if nq <= chunk:
         return fn(Q, probe_ids)
+    # Pad the ragged tail up to the shared chunk shape so every chunk hits
+    # one XLA compilation (a distinct tail shape would compile twice over
+    # the high-latency device link); padded rows are sliced off after.
+    pad = (-nq) % chunk
+    if pad:
+        Q = jnp.concatenate([Q, jnp.broadcast_to(Q[:1], (pad, Q.shape[1]))])
+        probe_ids = jnp.concatenate(
+            [probe_ids, jnp.broadcast_to(probe_ids[:1],
+                                         (pad, probe_ids.shape[1]))])
     outs = [fn(Q[s:s + chunk], probe_ids[s:s + chunk])
             for s in range(0, Q.shape[0], chunk)]
-    return (jnp.concatenate([o[0] for o in outs], axis=0),
-            jnp.concatenate([o[1] for o in outs], axis=0))
+    return (jnp.concatenate([o[0] for o in outs], axis=0)[:nq],
+            jnp.concatenate([o[1] for o in outs], axis=0)[:nq])
+
+
+# Per-engine-dispatch memory budget for the bucketed query-gather table
+# (n_lists, bucket_cap, dim) f32 — beyond it, auto falls back to scan.
+_BUCKET_TABLE_BYTES = 512 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _front_rank_contention(probe_ids, n_lists: int):
+    """Max per-list count of (query, probe) pairs whose centroid rank is in
+    the best half of each query's probe list. A bucket capacity ≥ this
+    value guarantees the bucketed engine only ever drops rank ≥ n_probes/2
+    probes of contended lists (see SearchParams)."""
+    half = max(1, probe_ids.shape[1] - probe_ids.shape[1] // 2)
+    front = probe_ids[:, :half]
+    return jnp.max(jnp.bincount(front.reshape(-1), length=n_lists))
 
 
 def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
-                 k: int, bucket_cap: int, allow_bucketed: bool = True):
+                 k: int, bucket_cap: int, dim: int, probe_ids,
+                 allow_bucketed: bool = True):
     """Resolve SearchParams.engine/"auto" and the bucket capacity — shared
     by ivf_flat.search and ivf_pq.search. Bucketed wins when the mean probe
     load per list fills MXU tiles; tiny loads leave the batched kernel
-    mostly padding."""
+    mostly padding.
+
+    Auto-sized bucket capacity is measured from the probe map (one jitted
+    scalar device→host read): the capacity covers every pair whose centroid
+    rank is in the query's best half, so only farthest-rank probes of
+    contended lists can drop — never a query's best probes. If that
+    capacity would blow the bucket-table memory budget (pathological skew),
+    auto falls back to the exact scan engine instead of truncating hot
+    lists. An explicit ``bucket_cap`` skips the measurement and accepts
+    the documented drop behavior at that capacity.
+    """
     expects(engine in ("auto", "scan", "bucketed"),
             f"unknown engine {engine!r} (auto|scan|bucketed)")
+    cap_q = bucket_cap
+    cap_clamp = max(8, _BUCKET_TABLE_BYTES // max(n_lists * dim * 4, 1))
+    mean_load = max(1, (n_queries * n_probes) // n_lists)
+
+    def measured_cap():
+        front = int(_front_rank_contention(probe_ids, n_lists))
+        # Next power of two: batches with slightly different contention
+        # land on the same compiled bucket shapes.
+        cap = 1 << (max(front, 4 * mean_load, 8) - 1).bit_length()
+        return min(n_queries, cap)
+
     if engine == "auto":
         load = n_queries * n_probes / n_lists
-        engine = ("bucketed"
-                  if allow_bucketed and jax.default_backend() == "tpu"
-                  and load >= 8 and k <= 128 else "scan")
-    cap_q = bucket_cap
-    if engine == "bucketed" and cap_q == 0:
-        mean_load = max(1, (n_queries * n_probes) // n_lists)
-        cap_q = min(n_queries, 8 * ceildiv(4 * mean_load, 8))
+        if (allow_bucketed and jax.default_backend() == "tpu"
+                and load >= 8 and k <= 128):
+            if cap_q == 0:
+                cap_q = measured_cap()
+                engine = "bucketed" if cap_q <= cap_clamp else "scan"
+            else:
+                engine = "bucketed"
+        else:
+            engine = "scan"
+    elif engine == "bucketed" and cap_q == 0:
+        cap_q = min(measured_cap(), cap_clamp)
     # Debug log at the dispatch decision, like the reference's
     # RAFT_LOG_DEBUG at perf-relevant branches (SURVEY.md §5).
     logger.debug(
@@ -455,7 +513,8 @@ def search(
     dataf = _as_float(index.data)
 
     engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
-                                 index.n_lists, k, params.bucket_cap)
+                                 index.n_lists, k, params.bucket_cap,
+                                 index.dim, probe_ids)
     if engine == "bucketed":
         return _bucketed_probe_scan(
             Q, dataf, index.indices, index.list_sizes, probe_ids,

@@ -638,7 +638,7 @@ def search(
         * index.rot_dim * 2
     engine, cap_q = _pick_engine(
         params.engine, Q.shape[0], n_probes, index.n_lists, k,
-        params.bucket_cap,
+        params.bucket_cap, index.rot_dim, probe_ids,
         allow_bucketed=default_dtypes and recon_bytes <= _RECON_AUTO_BYTES)
     if engine == "bucketed":
         best_d, best_i = _bucketed_probe_scan(

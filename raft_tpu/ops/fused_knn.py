@@ -306,6 +306,9 @@ def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
     dp = round_up_safe(queries.shape[2], _LANES)
     while bd > 256 and bd * dp * 4 > 4 * 1024 * 1024:
         bd //= 2
+    # Halving can land off the lane grid (e.g. 1920 -> 960 -> 480): keep the
+    # db-tile BlockSpec lane-aligned or Mosaic may fail to lower it.
+    bd = max(_LANES, bd // _LANES * _LANES)
     bd = min(bd, round_up_safe(n, _LANES))
     return _fused_batch_knn(queries, db, invalid, k, metric == "l2", sqrt,
                             bd, bf16, interpret)

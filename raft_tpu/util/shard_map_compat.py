@@ -9,14 +9,19 @@ so this wrapper pins that behavior under whichever spelling exists.
 
 from __future__ import annotations
 
+import inspect
+
 try:
     from jax import shard_map as _shard_map
-
-    _FLAG = "check_vma"
 except ImportError:  # pragma: no cover - jax < 0.8
     from jax.experimental.shard_map import shard_map as _shard_map
 
-    _FLAG = "check_rep"
+# Feature-detect the flag name rather than keying on the import location:
+# a transitional release could expose jax.shard_map while still spelling
+# the kwarg check_rep.
+_FLAG = ("check_vma"
+         if "check_vma" in inspect.signature(_shard_map).parameters
+         else "check_rep")
 
 
 def shard_map(fn, mesh, in_specs, out_specs):

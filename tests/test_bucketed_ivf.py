@@ -131,6 +131,29 @@ def test_ivf_pq_recon_cache_no_tracer_poisoning(rng):
     np.testing.assert_allclose(np.asarray(d1), np.asarray(d2), atol=1e-3)
 
 
+def test_bucketed_measured_cap_skewed_queries(rng):
+    """Hot-list contention: every query's best probe is the same list, so a
+    mean-sized bucket_cap would drop best-rank probes (the round-1 policy
+    bug). bucket_cap=0 sizes from the measured max per-list load and must
+    agree with the scan engine exactly."""
+    n, d, qn, k = 3000, 24, 200, 10
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    # All queries land on one cluster of the database -> one hot list.
+    hot = db[:40].mean(0)
+    Q = (hot[None, :] + 0.05 * rng.normal(size=(qn, d))).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=24, kmeans_n_iters=5),
+                         db)
+    sd, si = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=6, engine="scan"), idx, Q, k)
+    bd, bi = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=6, engine="bucketed", bucket_cap=0),
+        idx, Q, k)
+    agree = np.mean([
+        len(np.intersect1d(np.asarray(si)[r], np.asarray(bi)[r])) / k
+        for r in range(qn)])
+    assert agree > 0.999, f"measured-cap bucketed != scan on skew: {agree}"
+
+
 def test_bucketed_auto_cap_recall(rng):
     """Tight auto bucket_cap loses at most the documented overflow — recall
     stays above the reference's n_probes/n_lists lower bound
