@@ -18,9 +18,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.neighbors.brute_force import tiled_brute_force_knn
 from raft_tpu.sparse.types import COO, CSR
-from raft_tpu.sparse.distance import pairwise_distance as sparse_pairwise
-from raft_tpu.matrix.select_k import select_k
-from raft_tpu.distance.distance_types import is_min_close
+from raft_tpu.sparse.distance import knn_blocked
 
 
 def brute_force_knn(
@@ -30,11 +28,11 @@ def brute_force_knn(
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN between CSR row sets (ref:
     raft::sparse::neighbors::brute_force_knn, sparse/neighbors/brute_force.cuh
-    — batched pairwise + select_k). Returns (distances, indices)."""
-    metric = resolve_metric(metric)
-    d = sparse_pairwise(query, idx, metric=metric, metric_arg=metric_arg)
-    k = min(k, idx.shape[0])
-    return select_k(d, k, select_min=is_min_close(metric))
+    — batched pairwise + select_k). Returns (distances, indices). Large
+    high-dimensional inputs run block-tiled with a top-k-merged carry
+    (sparse/distance.knn_blocked), never materializing a dense operand or
+    the full (m, n) distance matrix."""
+    return knn_blocked(idx, query, k, metric=metric, metric_arg=metric_arg)
 
 
 def knn_graph(
