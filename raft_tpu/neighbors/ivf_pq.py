@@ -18,10 +18,12 @@ TPU-native re-design:
   ``pq_dim`` subspace codebooks (or all ``n_lists`` per-cluster codebooks)
   train simultaneously as one batched program on the MXU, replacing the
   reference's per-subspace kernel launches;
-* codes are stored **unpacked, one uint8 per sub-vector**, in the same
-  capacity-padded list tensor layout as IVF-Flat — XLA's static shapes
-  replace the bit-packed interleaved groups (4-bit packing is a later
-  memory optimization, not a compute-layout requirement on TPU);
+* codes are stored **bit-packed** (⌈pq_dim·pq_bits/8⌉ bytes per row, the
+  memory layout parity of the reference's ``list_spec``,
+  ivf_pq_types.hpp:172-209) in the same capacity-padded list tensor layout
+  as IVF-Flat; pack/unpack are branch-free vectorized bitfield ops over
+  static per-subspace byte/shift tables, so the scan engine unpacks one
+  probed list tile at a time on the VPU;
 * the search LUT scan is a ``lax.scan`` over probe ranks: each step builds
   the (q, pq_dim, 2^bits) LUT for the probed cluster (batched matmul
   epilogue of the residual), scores the probed list with a batched
@@ -64,6 +66,49 @@ class CodebookGen(enum.Enum):
     PER_CLUSTER = 1
 
 
+# ---------------------------------------------------------------------------
+# Bit-packed code storage (ref: the bit-compressed interleaved list_spec,
+# ivf_pq_types.hpp:172-209 — here a flat byte stream per row, with the
+# per-subspace byte offset/shift tables resolved at trace time).
+
+
+def packed_row_bytes(pq_dim: int, pq_bits: int) -> int:
+    return ceildiv(pq_dim * pq_bits, 8)
+
+
+def _bitfield_tables(pq_dim: int, pq_bits: int):
+    """Static (byte_idx, shift) of each subspace's b-bit field within the
+    row byte stream; every field spans at most two bytes (pq_bits ≤ 8)."""
+    bitpos = np.arange(pq_dim, dtype=np.int64) * pq_bits
+    return (jnp.asarray(bitpos // 8, jnp.int32),
+            jnp.asarray(bitpos % 8, jnp.int32))
+
+
+def pack_codes(codes: jax.Array, pq_bits: int) -> jax.Array:
+    """(…, pq_dim) code ids → (…, packed_row_bytes) uint8. Fields never
+    overlap, so the two byte-projections of each field scatter-add without
+    carries (add ≡ or)."""
+    pq_dim = codes.shape[-1]
+    nbytes = packed_row_bytes(pq_dim, pq_bits)
+    byte_idx, shift = _bitfield_tables(pq_dim, pq_bits)
+    u = codes.astype(jnp.int32) << shift                  # ≤ 16 bits
+    lead = codes.shape[:-1]
+    out = jnp.zeros(lead + (nbytes + 1,), jnp.int32)
+    out = out.at[..., byte_idx].add(u & 0xFF)
+    out = out.at[..., byte_idx + 1].add(u >> 8)
+    return out[..., :nbytes].astype(jnp.uint8)
+
+
+def unpack_codes(packed: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
+    """(…, packed_row_bytes) uint8 → (…, pq_dim) int32 code ids."""
+    byte_idx, shift = _bitfield_tables(pq_dim, pq_bits)
+    p = packed.astype(jnp.int32)
+    pad = jnp.zeros(packed.shape[:-1] + (1,), jnp.int32)
+    p = jnp.concatenate([p, pad], axis=-1)
+    u16 = p[..., byte_idx] | (p[..., byte_idx + 1] << 8)
+    return (u16 >> shift) & ((1 << pq_bits) - 1)
+
+
 @dataclass
 class IndexParams:
     """Ref: ivf_pq::index_params (ivf_pq_types.hpp:50-100); names/defaults
@@ -85,7 +130,9 @@ class IndexParams:
 @dataclass
 class SearchParams:
     """Ref: ivf_pq::search_params (ivf_pq_types.hpp:110-135). ``lut_dtype``
-    / ``internal_distance_dtype`` accept jnp dtypes (fp32/bf16/fp16);
+    / ``internal_distance_dtype`` accept jnp dtypes (fp32/bf16/fp16, plus
+    ``uint8`` for lut_dtype — an affine per-(query, subspace) quantized LUT,
+    the analog of the reference's fp_8bit, ivf_pq_search.cuh:70);
     lower-precision LUTs trade recall for VMEM footprint exactly like the
     reference's fp8/fp16 LUT options."""
 
@@ -112,14 +159,21 @@ class Index:
     centers: jax.Array            # (n_lists, dim)
     rotation_matrix: jax.Array    # (rot_dim, dim)
     pq_centers: jax.Array
-    pq_codes: jax.Array           # (n_lists, cap, pq_dim) uint8
+    pq_codes: jax.Array           # (n_lists, cap, packed_row_bytes) uint8
     indices: jax.Array            # (n_lists, cap) int32
     list_sizes: jax.Array         # (n_lists,) int32
     pq_bits: int = 8
+    pq_dim: int = 0
     conservative_memory_allocation: bool = False
     # Lazy bf16 reconstruction cache (n_lists, cap, rot_dim) backing the
     # bucketed search engine; see reconstructed(). Not serialized.
     _recon: Optional[jax.Array] = None
+
+    def __post_init__(self):
+        # pq_dim is load-bearing (codes are bit-packed, so it is no longer
+        # derivable from pq_codes.shape) — fail at construction, not at the
+        # first pq_len division.
+        expects(self.pq_dim > 0, "Index requires pq_dim > 0")
 
     @property
     def n_lists(self) -> int:
@@ -132,10 +186,6 @@ class Index:
     @property
     def rot_dim(self) -> int:
         return self.rotation_matrix.shape[0]
-
-    @property
-    def pq_dim(self) -> int:
-        return self.pq_codes.shape[2]
 
     @property
     def pq_len(self) -> int:
@@ -175,7 +225,8 @@ class Index:
         body XLA will re-run the decode every iteration.
         """
         if self._recon is None:
-            n_lists, cap, J = self.pq_codes.shape
+            n_lists, cap, _ = self.pq_codes.shape
+            J = self.pq_dim
             B, L = self.pq_book_size, self.pq_len
             per_cluster = self.codebook_kind == CodebookGen.PER_CLUSTER
             # Flat 1-D gather with a (rows, J·L = rot_dim) output: a naive
@@ -190,9 +241,10 @@ class Index:
 
             def decode_lists(args):
                 # per-subspace books: one shared flat book table
-                codes_c, crot_c = args                     # (lc, cap, J), (lc, rot)
+                codes_c, crot_c = args          # (lc, cap, nbytes), (lc, rot)
                 lc = codes_c.shape[0]
-                codes2 = codes_c.reshape(lc * cap, J).astype(jnp.int32)
+                codes2 = unpack_codes(codes_c, J, self.pq_bits).reshape(
+                    lc * cap, J)
                 idx = jbase + codes2[:, :, None] * L + lp[None, None, :]
                 cw = flat_books[idx.reshape(lc * cap, J * L)]
                 cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
@@ -211,7 +263,8 @@ class Index:
                 def decode_pc(args):
                     codes_c, crot_c, fb = args
                     lc = codes_c.shape[0]
-                    codes2 = codes_c.reshape(lc * cap, J).astype(jnp.int32)
+                    codes2 = unpack_codes(codes_c, J, self.pq_bits).reshape(
+                        lc * cap, J)
                     base = jnp.repeat(
                         jnp.arange(lc, dtype=jnp.int32) * (B * L), cap
                     )[:, None, None]
@@ -221,13 +274,13 @@ class Index:
                     return cw.astype(jnp.bfloat16)
 
                 recon = lax.map(decode_pc, (
-                    self.pq_codes.reshape(nc, chunk, cap, J),
+                    self.pq_codes.reshape(nc, chunk, cap, -1),
                     centers_rot.reshape(nc, chunk, -1),
                     books_c,
                 )).reshape(n_lists, cap, J * L)
             else:
                 recon = lax.map(decode_lists, (
-                    self.pq_codes.reshape(nc, chunk, cap, J),
+                    self.pq_codes.reshape(nc, chunk, cap, -1),
                     centers_rot.reshape(nc, chunk, -1),
                 )).reshape(n_lists, cap, J * L)
             if isinstance(recon, jax.core.Tracer):
@@ -451,10 +504,13 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
         centers=centers,
         rotation_matrix=rot,
         pq_centers=pq_centers,
-        pq_codes=jnp.zeros((params.n_lists, 1, pq_dim), jnp.uint8),
+        pq_codes=jnp.zeros(
+            (params.n_lists, 1, packed_row_bytes(pq_dim, params.pq_bits)),
+            jnp.uint8),
         indices=jnp.full((params.n_lists, 1), -1, jnp.int32),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
         pq_bits=params.pq_bits,
+        pq_dim=pq_dim,
         conservative_memory_allocation=params.conservative_memory_allocation,
     )
     if params.add_data_on_build:
@@ -483,14 +539,16 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         codes = _encode(res, index.pq_centers)
     else:
         codes = _encode_per_cluster(res, labels, index.pq_centers)
+    codes = pack_codes(codes, index.pq_bits)
 
-    # Merge with existing valid rows (codes are row-vectors of pq_dim bytes).
+    # Merge with existing valid rows (codes are bit-packed byte rows).
     old_n = index.size
     if old_n:
         cap = index.pq_codes.shape[1]
         slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
         valid = (slot < index.list_sizes[:, None]).reshape(-1)
-        old_codes = index.pq_codes.reshape(-1, index.pq_dim)[valid]
+        old_codes = index.pq_codes.reshape(
+            -1, index.pq_codes.shape[2])[valid]
         old_ids = index.indices.reshape(-1)[valid]
         old_labels = jnp.repeat(
             jnp.arange(index.n_lists, dtype=jnp.int32), index.list_sizes,
@@ -513,6 +571,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         centers=index.centers, rotation_matrix=index.rotation_matrix,
         pq_centers=index.pq_centers, pq_codes=packed.astype(jnp.uint8),
         indices=ids, list_sizes=sizes, pq_bits=index.pq_bits,
+        pq_dim=index.pq_dim,
         conservative_memory_allocation=index.conservative_memory_allocation,
     )
 
@@ -533,10 +592,11 @@ def _select_clusters(args, n_probes: int, is_ip: bool):
     return probe_ids
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
 def _pq_probe_scan(
     rotq, probe_ids, pq_codes, indices, list_sizes,
     k: int, is_ip: bool, per_cluster: bool, lut_dtype,
+    pq_dim: int, pq_bits: int,
     pq_centers=None, centers_rot=None,
 ):
     """LUT-scored probe scan (ref: compute_similarity_kernel,
@@ -544,11 +604,15 @@ def _pq_probe_scan(
 
     rotq: (q, rot_dim) rotated queries; centers_rot: (n_lists, rot_dim)
     rotated centers. Per probe step: residual LUT (q, pq_dim, book) from a
-    batched matmul; list scores via take_along_axis gather over the code
-    axis; running top-k fold.
+    batched matmul; the probed lists' bit-packed codes unpack on the VPU;
+    list scores via take_along_axis gather over the code axis; running
+    top-k fold. ``lut_dtype=uint8`` quantizes the LUT per (query, subspace)
+    with an affine u8 code — the role of the reference's ``fp_8bit`` LUT
+    (ivf_pq_search.cuh:70), trading ≤1/255-of-range error per subspace for
+    a 4× smaller LUT.
     """
     q, rot_dim = rotq.shape
-    n_lists, cap, pq_dim = pq_codes.shape
+    n_lists, cap, _ = pq_codes.shape
     pq_len = rot_dim // pq_dim
     worst = -jnp.inf if is_ip else jnp.inf
     slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
@@ -581,16 +645,27 @@ def _pq_probe_scan(
                                    precision=lax.Precision.HIGHEST)
             )
             qc = jnp.zeros((q,), jnp.float32)
-        lut = lut.astype(lut_dtype)
 
-        codes = pq_codes[lists].astype(jnp.int32)          # (q, cap, pq_dim)
+        codes = unpack_codes(pq_codes[lists], pq_dim, pq_bits)  # (q, cap, J)
         ids = indices[lists]
         invalid = slot >= list_sizes[lists][:, None]
         # score[c] = Σ_j LUT[j, codes[c, j]] — batched gather
         # (the decision point flagged in SURVEY.md §7: gather vs one-hot
         # matmul; gather keeps HBM traffic at cap·pq_dim ints).
-        gathered = jnp.take_along_axis(lut, codes.transpose(0, 2, 1), axis=2)
-        scores = jnp.sum(gathered, axis=1).astype(jnp.float32)  # (q, cap)
+        if jnp.dtype(lut_dtype) == jnp.uint8:
+            # Affine u8 quantization per (query, subspace) — fp_8bit analog.
+            lmin = jnp.min(lut, axis=2, keepdims=True)
+            scale = (jnp.max(lut, axis=2, keepdims=True) - lmin) / 255.0
+            lut_q = jnp.round(
+                (lut - lmin) / jnp.maximum(scale, 1e-30)).astype(jnp.uint8)
+            gathered = jnp.take_along_axis(lut_q, codes.transpose(0, 2, 1),
+                                           axis=2).astype(jnp.float32)
+            scores = jnp.sum(gathered * scale + lmin, axis=1)
+        else:
+            lut = lut.astype(lut_dtype)
+            gathered = jnp.take_along_axis(lut, codes.transpose(0, 2, 1),
+                                           axis=2)
+            scores = jnp.sum(gathered, axis=1).astype(jnp.float32)  # (q, cap)
         scores = scores + qc[:, None]
         scores = jnp.where(invalid, worst, scores)
         cat_d = jnp.concatenate([best_d, scores], axis=1)
@@ -629,7 +704,7 @@ def search(
 
     # "auto" only switches to the recon-cache engine when the LUT dtype
     # knobs are at their defaults — an explicit lut_dtype/internal dtype
-    # request is honored by the LUT scan path (an explicit
+    # request (fp16/bf16/uint8) is honored by the LUT scan path (an explicit
     # engine="bucketed" overrides, documented on SearchParams).
     default_dtypes = (jnp.dtype(params.lut_dtype) == jnp.float32
                       and jnp.dtype(params.internal_distance_dtype)
@@ -664,7 +739,7 @@ def search(
             rq, pid,
             index.pq_codes, index.indices, index.list_sizes,
             k, is_ip, index.codebook_kind == CodebookGen.PER_CLUSTER,
-            jnp.dtype(params.lut_dtype),
+            jnp.dtype(params.lut_dtype), index.pq_dim, index.pq_bits,
             pq_centers=index.pq_centers, centers_rot=centers_rot,
         ),
         rotq, probe_ids, per_q)
@@ -677,7 +752,9 @@ def search(
 # Serialization (ref: detail/ivf_pq_serialize.cuh:38, kSerializationVersion=3,
 # scalars + mdspans at :63-100).
 
-SERIALIZATION_VERSION = 3
+# v4: pq_codes became bit-packed byte rows (+ explicit pq_dim scalar); the
+# reference bumps its kSerializationVersion on layout changes the same way.
+SERIALIZATION_VERSION = 4
 
 
 def save(filename: str, index: Index) -> None:
@@ -688,6 +765,7 @@ def save(filename: str, index: Index) -> None:
         metric=np.int64(index.metric.value),
         codebook_kind=np.int64(index.codebook_kind.value),
         pq_bits=np.int64(index.pq_bits),
+        pq_dim=np.int64(index.pq_dim),
         conservative=np.bool_(index.conservative_memory_allocation),
         centers=np.asarray(index.centers),
         rotation_matrix=np.asarray(index.rotation_matrix),
@@ -716,5 +794,6 @@ def load(filename: str) -> Index:
             indices=jnp.asarray(z["indices"]),
             list_sizes=jnp.asarray(z["list_sizes"]),
             pq_bits=int(z["pq_bits"]),
+            pq_dim=int(z["pq_dim"]),
             conservative_memory_allocation=bool(z["conservative"]),
         )

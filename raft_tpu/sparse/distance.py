@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.logger import logger
 from raft_tpu.distance.distance_types import (DistanceType, is_min_close,
                                               resolve_metric)
 from raft_tpu.distance.pairwise import distance as dense_distance
@@ -96,12 +97,23 @@ def _block_pad_csr(x: CSR, b: int):
     """Pack CSR entries into (n_blocks, cap) nnz-padded per-row-block arrays
     (the `_pack_lists` idiom): returns (rloc, cols, vals) with sentinel
     rloc=b / cols=dim on padding slots, plus the per-block row-stat tensor
-    (n_blocks, 2, b) of (Σv, Σv²) computed straight from the CSR values."""
+    (n_blocks, 2, b) of (Σv, Σv²) computed straight from the CSR values.
+
+    ``cap`` is the max block nnz (one static shape for the y-block scan),
+    so packed memory is ∝ nnz for roughly-uniform row densities and
+    degrades towards ∝ m·max_row_nnz when a few rows are much denser than
+    the rest — the same per-strategy density envelope the reference's
+    coo_spmv strategies carry. Heavy skew is surfaced in the debug log."""
     m, d = x.shape
     nb = ceildiv(m, b)
     bounds = x.indptr[jnp.minimum(
         jnp.arange(nb + 1, dtype=jnp.int32) * b, m)]
     cap = max(int(jnp.max(jnp.diff(bounds))), 1)
+    if nb * cap > 4 * max(x.nnz, 1):
+        logger.debug(
+            "sparse block packing is %.0fx the nnz (skewed row density: "
+            "cap=%d over %d blocks, nnz=%d) — memory follows the densest "
+            "row block", nb * cap / max(x.nnz, 1), cap, nb, x.nnz)
 
     rows = x.row_ids()
     blk = rows // b

@@ -83,6 +83,48 @@ class TestIvfPq:
         # (ref: fp8/low-bit threshold formula, ann_ivf_pq.cuh:257-265).
         assert _recall(np.asarray(i), truth) > 0.3
 
+    @pytest.mark.parametrize("bits", [4, 5, 6, 7, 8])
+    def test_pack_unpack_roundtrip(self, bits):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(bits)
+        codes = rng.integers(0, 1 << bits, size=(13, 4, 23)).astype(np.uint8)
+        packed = ivf_pq.pack_codes(jnp.asarray(codes), bits)
+        assert packed.shape[-1] == ivf_pq.packed_row_bytes(23, bits)
+        back = ivf_pq.unpack_codes(packed, 23, bits)
+        np.testing.assert_array_equal(np.asarray(back), codes)
+
+    def test_pq4_index_half_the_bytes_of_pq8(self, dataset):
+        """Ref memory parity: pq_bits=4 stores codes in half the bytes of
+        pq_bits=8 (bit-packed list_spec, ivf_pq_types.hpp:172-209), at the
+        dim-scaled recall bound (ann_ivf_pq.cuh:257-265 formula family)."""
+        db, q, truth = dataset
+        mk = lambda bits: ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, pq_bits=bits,
+                               kmeans_n_iters=10), db)
+        i4, i8 = mk(4), mk(8)
+        assert i4.pq_codes.shape[1] == i8.pq_codes.shape[1]  # same capacity
+        assert i4.pq_codes.shape[2] * 2 == i8.pq_codes.shape[2]
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), i4, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.3
+
+    def test_u8_lut(self, dataset):
+        """uint8 LUT (the fp_8bit analog, ivf_pq_search.cuh:70) must stay
+        within a few recall points of the f32 LUT."""
+        import jax.numpy as jnp
+
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        d32, i32 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, engine="scan"), index, q, 10)
+        d8, i8 = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=32, lut_dtype=jnp.uint8,
+                                engine="scan"), index, q, 10)
+        r32 = _recall(np.asarray(i32), truth)
+        r8 = _recall(np.asarray(i8), truth)
+        assert r8 >= r32 - 0.05, (r8, r32)
+
     def test_bf16_lut(self, dataset):
         import jax.numpy as jnp
 
