@@ -22,11 +22,14 @@ from pylibraft.neighbors.common import (
 
 class IndexParams:
     """Ref ivf_flat.pyx IndexParams; metric accepts the ANN metric strings
-    {"sqeuclidean", "euclidean", "inner_product"}."""
+    {"sqeuclidean", "euclidean", "inner_product"}. ``idx_dtype`` selects
+    the neighbor-id dtype (the reference binds int64_t; int64 here
+    requires jax_enable_x64, int32 is the TPU-fast default)."""
 
     def __init__(self, *, n_lists=1024, metric="sqeuclidean",
                  kmeans_n_iters=20, kmeans_trainset_fraction=0.5,
-                 add_data_on_build=True, adaptive_centers=False):
+                 add_data_on_build=True, adaptive_centers=False,
+                 idx_dtype="int32"):
         self.params = _impl.IndexParams(
             n_lists=n_lists,
             metric=_get_metric(metric),
@@ -34,6 +37,7 @@ class IndexParams:
             kmeans_trainset_fraction=kmeans_trainset_fraction,
             add_data_on_build=add_data_on_build,
             adaptive_centers=adaptive_centers,
+            idx_dtype=idx_dtype,
         )
 
     @property

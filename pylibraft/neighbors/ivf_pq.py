@@ -37,7 +37,7 @@ class IndexParams:
                  kmeans_n_iters=20, kmeans_trainset_fraction=0.5,
                  pq_bits=8, pq_dim=0, codebook_kind="subspace",
                  force_random_rotation=False, add_data_on_build=True,
-                 conservative_memory_allocation=False):
+                 conservative_memory_allocation=False, idx_dtype="int32"):
         if codebook_kind not in _CODEBOOK_KINDS:
             raise ValueError(f"codebook_kind must be in {sorted(_CODEBOOK_KINDS)}")
         self.params = _impl.IndexParams(
@@ -50,6 +50,7 @@ class IndexParams:
             codebook_kind=_CODEBOOK_KINDS[codebook_kind],
             force_random_rotation=force_random_rotation,
             add_data_on_build=add_data_on_build,
+            idx_dtype=idx_dtype,
             conservative_memory_allocation=conservative_memory_allocation,
         )
 

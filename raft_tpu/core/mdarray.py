@@ -142,3 +142,25 @@ def make_vector(size: int, dtype=jnp.float32) -> jax.Array:
 
 def make_scalar(value=0, dtype=jnp.float32) -> jax.Array:
     return jnp.asarray(value, dtype=dtype)
+
+
+def validate_idx_dtype(dtype) -> "jnp.dtype":
+    """Validate a neighbor-id dtype knob (ref: the IdxT template parameter
+    of the reference's kNN surface — int64_t in the runtime API,
+    cpp/src/neighbors/brute_force_knn_int64_t_float.cu; uint32 internally).
+
+    int32 is the default (fastest on TPU); int64 gives the reference's
+    id-dtype parity and requires the global ``jax_enable_x64`` flag —
+    without it JAX silently truncates 64-bit arrays.
+    """
+    from raft_tpu.core.error import expects
+
+    dt = jnp.dtype(dtype)
+    expects(dt in (jnp.dtype(jnp.int32), jnp.dtype(jnp.int64)),
+            f"idx_dtype must be int32 or int64, got {dt}")
+    if dt == jnp.dtype(jnp.int64):
+        expects(bool(jax.config.jax_enable_x64),
+                "int64 neighbor ids require jax_enable_x64 "
+                "(jax.config.update('jax_enable_x64', True) or "
+                "JAX_ENABLE_X64=1)")
+    return dt

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.error import expects
-from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.mdarray import as_array, validate_idx_dtype
 from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
 from raft_tpu.matrix.select_k import select_k
@@ -227,6 +227,7 @@ def knn(
     global_id_offset: int = 0,
     handle=None,
     method: str = "auto",
+    idx_dtype=jnp.int32,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact kNN over one or several database parts.
 
@@ -236,9 +237,17 @@ def knn(
     searched independently and merged (the reference round-robins parts over
     pool streams; XLA overlaps them through async dispatch).
 
-    Returns ``(distances (n_queries, k), indices (n_queries, k) int32)``.
+    ``idx_dtype`` selects the neighbor-id dtype: int32 (default, like the
+    reference's internal uint32 kernels) or int64 (the reference runtime
+    surface, brute_force_knn_int64_t_float.cu — requires jax_enable_x64).
+    Per-part positions stay int32 internally; the widening happens before
+    global id offsets are applied, so multi-part id spaces past 2³¹ rows
+    are representable.
+
+    Returns ``(distances (n_queries, k), indices (n_queries, k))``.
     """
     metric = resolve_metric(metric)
+    idx_dtype = validate_idx_dtype(idx_dtype)
     parts: List[jax.Array]
     if isinstance(index, (list, tuple)):
         parts = [as_array(p) for p in index]
@@ -249,8 +258,9 @@ def knn(
     if len(parts) == 1:
         d, i = tiled_brute_force_knn(queries, parts[0], k, metric, metric_arg,
                                      method=method)
+        i = i.astype(idx_dtype)
         if global_id_offset:
-            i = i + global_id_offset
+            i = i + jnp.asarray(global_id_offset, idx_dtype)
         return d, i
 
     all_d, all_i, offsets = [], [], []
@@ -258,6 +268,7 @@ def knn(
     for p in parts:
         pd, pi = tiled_brute_force_knn(queries, p, min(k, p.shape[0]), metric,
                                        metric_arg, method=method)
+        pi = pi.astype(idx_dtype)
         kk = pd.shape[1]
         if kk < k:  # pad small parts so merge shapes agree
             worst = jnp.inf if is_min_close(metric) else -jnp.inf

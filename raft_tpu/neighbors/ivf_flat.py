@@ -37,7 +37,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import logger
-from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.mdarray import as_array, validate_idx_dtype
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
@@ -59,6 +59,10 @@ class IndexParams:
     kmeans_trainset_fraction: float = 0.5
     adaptive_centers: bool = False
     conservative_memory_allocation: bool = False
+    # Neighbor-id dtype: int32 (default) or int64 (the reference's IdxT
+    # runtime surface; requires jax_enable_x64). TPU extension knob — the
+    # reference fixes IdxT per instantiation unit instead.
+    idx_dtype: object = jnp.int32
 
 
 @dataclass
@@ -105,7 +109,7 @@ class Index:
     metric: DistanceType
     centers: jax.Array          # (n_lists, dim)
     data: jax.Array             # (n_lists, cap, dim)
-    indices: jax.Array          # (n_lists, cap) int32 — global source row ids
+    indices: jax.Array          # (n_lists, cap) int32/int64 global row ids
     list_sizes: jax.Array       # (n_lists,) int32
     adaptive_centers: bool = False
     conservative_memory_allocation: bool = False
@@ -156,9 +160,9 @@ def _pack_lists(
     pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
 
     data = jnp.zeros((n_lists, cap, d), X.dtype)
-    idx = jnp.full((n_lists, cap), -1, jnp.int32)
+    idx = jnp.full((n_lists, cap), -1, ids.dtype)
     data = data.at[sorted_labels, pos].set(X[order])
-    idx = idx.at[sorted_labels, pos].set(ids[order].astype(jnp.int32))
+    idx = idx.at[sorted_labels, pos].set(ids[order])
     return data, idx, counts.astype(jnp.int32)
 
 
@@ -187,17 +191,18 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     )
     centers = kmeans_balanced.fit(kb, trainset, params.n_lists)
 
+    idx_dtype = validate_idx_dtype(params.idx_dtype)
     index = Index(
         metric=params.metric,
         centers=centers,
         data=jnp.zeros((params.n_lists, 1, X.shape[1]), X.dtype),
-        indices=jnp.full((params.n_lists, 1), -1, jnp.int32),
+        indices=jnp.full((params.n_lists, 1), -1, idx_dtype),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
         adaptive_centers=params.adaptive_centers,
         conservative_memory_allocation=params.conservative_memory_allocation,
     )
     if params.add_data_on_build:
-        index = extend(index, X, jnp.arange(n, dtype=jnp.int32))
+        index = extend(index, X, jnp.arange(n, dtype=idx_dtype))
     return index
 
 
@@ -215,9 +220,10 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     n_new = X.shape[0]
     if new_indices is None:
         base = index.size
-        new_indices = jnp.arange(base, base + n_new, dtype=jnp.int32)
+        new_indices = jnp.arange(base, base + n_new,
+                                 dtype=index.indices.dtype)
     else:
-        new_indices = as_array(new_indices).astype(jnp.int32)
+        new_indices = as_array(new_indices).astype(index.indices.dtype)
 
     labels = kmeans_balanced.predict(
         KMeansBalancedParams(metric=index.metric), index.centers, _as_float(X)
@@ -303,7 +309,7 @@ def _probe_scan(
                 jnp.take_along_axis(cat_i, pos, axis=1)), None
 
     init = (jnp.full((q, k), worst, queries.dtype),
-            jnp.full((q, k), -1, jnp.int32))
+            jnp.full((q, k), -1, indices.dtype))
     (best_d, best_i), _ = lax.scan(body, init, probe_ids.T)
     if inner_is_l2 and sqrt:
         best_d = jnp.sqrt(best_d)

@@ -44,7 +44,7 @@ import numpy as np
 from jax import lax
 
 from raft_tpu.core.error import expects
-from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.mdarray import as_array, validate_idx_dtype
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
@@ -125,6 +125,9 @@ class IndexParams:
     force_random_rotation: bool = False
     add_data_on_build: bool = True
     conservative_memory_allocation: bool = False
+    # Neighbor-id dtype: int32 (default) or int64 (reference IdxT parity;
+    # requires jax_enable_x64). See ivf_flat.IndexParams.idx_dtype.
+    idx_dtype: object = jnp.int32
 
 
 @dataclass
@@ -507,14 +510,16 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
         pq_codes=jnp.zeros(
             (params.n_lists, 1, packed_row_bytes(pq_dim, params.pq_bits)),
             jnp.uint8),
-        indices=jnp.full((params.n_lists, 1), -1, jnp.int32),
+        indices=jnp.full((params.n_lists, 1), -1,
+                         validate_idx_dtype(params.idx_dtype)),
         list_sizes=jnp.zeros((params.n_lists,), jnp.int32),
         pq_bits=params.pq_bits,
         pq_dim=pq_dim,
         conservative_memory_allocation=params.conservative_memory_allocation,
     )
     if params.add_data_on_build:
-        index = extend(index, X, jnp.arange(n, dtype=jnp.int32))
+        index = extend(index, X,
+                       jnp.arange(n, dtype=index.indices.dtype))
     return index
 
 
@@ -527,9 +532,10 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     n_new = X.shape[0]
     if new_indices is None:
         base = index.size
-        new_indices = jnp.arange(base, base + n_new, dtype=jnp.int32)
+        new_indices = jnp.arange(base, base + n_new,
+                                 dtype=index.indices.dtype)
     else:
-        new_indices = as_array(new_indices).astype(jnp.int32)
+        new_indices = as_array(new_indices).astype(index.indices.dtype)
 
     kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
     labels = kmeans_balanced.predict(kb, index.centers, X)
@@ -676,7 +682,7 @@ def _pq_probe_scan(
                 jnp.take_along_axis(cat_i, pos, axis=1)), None
 
     init = (jnp.full((q, k), worst, jnp.float32),
-            jnp.full((q, k), -1, jnp.int32))
+            jnp.full((q, k), -1, indices.dtype))
     (best_d, best_i), _ = lax.scan(body, init, probe_ids.T)
     return best_d, best_i
 
