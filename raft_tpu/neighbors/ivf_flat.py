@@ -43,7 +43,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.random.rng_state import RngState
-from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.util.pow2 import ceildiv, next_pow2
 
 
 @dataclass
@@ -166,6 +166,41 @@ def _pack_lists(
     return data, idx, counts.astype(jnp.int32)
 
 
+def _train_centers(params, Xf: jax.Array) -> jax.Array:
+    """Subsample ``kmeans_trainset_fraction`` of the rows and train the
+    coarse centers (ref: the trainset subsample + kmeans_balanced::fit step
+    of detail/ivf_flat_build.cuh:299). Shared by the single-device and
+    sharded builds so both train the identical coarse model."""
+    n = Xf.shape[0]
+    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
+    n_train = max(params.n_lists, int(n * frac)) if frac < 1.0 else n
+    stride = max(1, n // n_train)
+    trainset = Xf[::stride][:n_train]
+    kb = KMeansBalancedParams(
+        n_iters=params.kmeans_n_iters,
+        metric=params.metric,
+        rng_state=RngState(seed=0),
+    )
+    return kmeans_balanced.fit(kb, trainset, params.n_lists)
+
+
+def _coarse_probe(Q: jax.Array, centers: jax.Array, n_probes: int,
+                  inner_is_l2: bool) -> jax.Array:
+    """Top-n_probes coarse quantizer (ref: the select_clusters-analog in
+    detail/ivf_flat_search.cuh) — shared by search and the sharded path so
+    both probe the identical candidate set."""
+    if inner_is_l2:
+        cn = jnp.sum(centers * centers, axis=1)
+        cd = (jnp.sum(Q * Q, axis=1)[:, None] + cn[None, :]
+              - 2.0 * jnp.matmul(Q, centers.T,
+                                 precision=lax.Precision.HIGHEST))
+        _, probe_ids = select_k(cd, n_probes, select_min=True)
+    else:
+        cd = jnp.matmul(Q, centers.T, precision=lax.Precision.HIGHEST)
+        _, probe_ids = select_k(cd, n_probes, select_min=False)
+    return probe_ids
+
+
 def build(params: IndexParams, dataset, handle=None) -> Index:
     """Train centers (balanced k-means on a subsample) and fill the lists.
 
@@ -179,17 +214,7 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     expects(n >= params.n_lists, "need at least n_lists rows")
     Xf = _as_float(X)
 
-    frac = min(max(params.kmeans_trainset_fraction, 0.0), 1.0)
-    n_train = max(params.n_lists, int(n * frac)) if frac < 1.0 else n
-    stride = max(1, n // n_train)
-    trainset = Xf[::stride][:n_train]
-
-    kb = KMeansBalancedParams(
-        n_iters=params.kmeans_n_iters,
-        metric=params.metric,
-        rng_state=RngState(seed=0),
-    )
-    centers = kmeans_balanced.fit(kb, trainset, params.n_lists)
+    centers = _train_centers(params, Xf)
 
     idx_dtype = validate_idx_dtype(params.idx_dtype)
     index = Index(
@@ -253,7 +278,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     min_cap = 0
     if not index.conservative_memory_allocation:
         counts = jnp.bincount(all_labels, length=index.n_lists)
-        min_cap = 1 << max(int(jnp.max(counts)) - 1, 0).bit_length()
+        min_cap = next_pow2(int(jnp.max(counts)))
     data, ids, sizes = _pack_lists(all_rows, all_labels, all_ids, index.n_lists, min_cap)
 
     centers = index.centers
@@ -506,15 +531,7 @@ def search(
 
     # Coarse quantizer: distances to centers + top-n_probes
     # (ref: select_clusters-analog in ivf_flat_search).
-    centers = index.centers
-    if inner_is_l2:
-        cn = jnp.sum(centers * centers, axis=1)
-        cd = (jnp.sum(Q * Q, axis=1)[:, None] + cn[None, :]
-              - 2.0 * jnp.matmul(Q, centers.T, precision=lax.Precision.HIGHEST))
-        _, probe_ids = select_k(cd, n_probes, select_min=True)
-    else:
-        cd = jnp.matmul(Q, centers.T, precision=lax.Precision.HIGHEST)
-        _, probe_ids = select_k(cd, n_probes, select_min=False)
+    probe_ids = _coarse_probe(Q, index.centers, n_probes, inner_is_l2)
 
     dataf = _as_float(index.data)
 

@@ -56,7 +56,7 @@ from raft_tpu.neighbors.ivf_flat import (
     _pick_engine,
 )
 from raft_tpu.random.rng_state import RngState
-from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.util.pow2 import ceildiv, next_pow2
 
 
 class CodebookGen(enum.Enum):
@@ -568,7 +568,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     min_cap = 0
     if not index.conservative_memory_allocation:
         counts = jnp.bincount(all_labels, length=index.n_lists)
-        min_cap = 1 << max(int(jnp.max(counts)) - 1, 0).bit_length()
+        min_cap = next_pow2(int(jnp.max(counts)))
     packed, ids, sizes = _pack_lists(all_codes, all_labels, all_ids,
                                      index.n_lists, min_cap)
 

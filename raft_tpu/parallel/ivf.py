@@ -1,0 +1,275 @@
+"""Multi-device IVF-Flat / IVF-PQ: shard the lists, search locally, merge.
+
+Ref pattern: the reference ships the comms layer + ``knn_merge_parts``
+(neighbors/brute_force.cuh:80) and downstream MNMG ANN shards database rows
+across ranks against a *shared* cluster model, searches each rank's shard,
+and merges the per-rank top-k (docs/source/using_comms.rst:1-40; SURVEY.md
+§2.12 item 4).
+
+TPU-native: one coarse model (balanced-kmeans centers, and for PQ the
+rotation + codebooks) is trained once and replicated; every device holds
+the capacity-padded list tensors of *its row shard only* (lists are
+per-shard slices of the same global clusters, so the union of all shards'
+list l is exactly the single-device list l). Search runs as a jitted
+``shard_map``: each device probes the shared centers, scans its local
+lists, and an ``all_gather`` over ICI merges the per-device top-k —
+communication is O(n_queries·k·n_devices), never the lists themselves.
+Search results are identical to the single-device index built from the
+same model, because the probed candidate set is the same by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.core.error import expects
+from raft_tpu.core.mdarray import validate_idx_dtype
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import ivf_flat as _flat
+from raft_tpu.neighbors import ivf_pq as _pq
+from raft_tpu.util.pow2 import next_pow2
+from raft_tpu.util.shard_map_compat import shard_map
+
+
+@dataclass
+class ShardedIvfFlat:
+    """IVF-Flat with list tensors row-sharded over a mesh axis; the coarse
+    centers are replicated (the shared cluster model of the MNMG pattern)."""
+
+    metric: DistanceType
+    centers: jax.Array      # (n_lists, dim) replicated
+    data: jax.Array         # (n_dev, n_lists, cap, dim) sharded on axis 0
+    indices: jax.Array      # (n_dev, n_lists, cap) global ids
+    list_sizes: jax.Array   # (n_dev, n_lists) int32
+    axis: str = "data"
+
+
+@dataclass
+class ShardedIvfPq:
+    """IVF-PQ with packed code tensors row-sharded over a mesh axis; the
+    coarse centers, rotation and codebooks are replicated."""
+
+    metric: DistanceType
+    codebook_kind: "_pq.CodebookGen"
+    centers: jax.Array
+    rotation_matrix: jax.Array
+    pq_centers: jax.Array
+    pq_codes: jax.Array     # (n_dev, n_lists, cap, nbytes) sharded on axis 0
+    indices: jax.Array      # (n_dev, n_lists, cap)
+    list_sizes: jax.Array   # (n_dev, n_lists)
+    pq_bits: int = 8
+    pq_dim: int = 0
+    axis: str = "data"
+
+
+def _shard_pack(mesh: Mesh, axis: str, rows, labels_h, ids, n_lists: int):
+    """Pack each row shard's lists at one common capacity and place the
+    stacked tensors sharded over ``mesh[axis]``."""
+    n_dev = mesh.shape[axis]
+    n = rows.shape[0]
+    shard = n // n_dev
+    counts = np.zeros((n_dev, n_lists), np.int64)
+    for s in range(n_dev):
+        counts[s] = np.bincount(labels_h[s * shard:(s + 1) * shard],
+                                minlength=n_lists)
+    cap = next_pow2(int(counts.max()))
+
+    packed = [
+        _flat._pack_lists(rows[s * shard:(s + 1) * shard],
+                          jnp.asarray(labels_h[s * shard:(s + 1) * shard]),
+                          ids[s * shard:(s + 1) * shard], n_lists,
+                          min_cap=cap)
+        for s in range(n_dev)
+    ]
+    sharding = NamedSharding(mesh, P(axis))
+    data = jax.device_put(jnp.stack([p[0] for p in packed]), sharding)
+    idx = jax.device_put(jnp.stack([p[1] for p in packed]), sharding)
+    sizes = jax.device_put(jnp.stack([p[2] for p in packed]), sharding)
+    return data, idx, sizes
+
+
+def sharded_ivf_flat_build(
+    mesh: Mesh, params: "_flat.IndexParams", dataset, axis: str = "data",
+    centers: Optional[jax.Array] = None,
+) -> ShardedIvfFlat:
+    """Build with rows sharded over ``mesh[axis]`` (ref: the MNMG
+    shard-then-merge recipe, using_comms.rst). ``centers`` injects a
+    pre-trained coarse model (otherwise trained like ivf_flat.build).
+    Row count must divide the axis size (pad upstream; static shapes)."""
+    X = _flat._as_float(_flat.as_array(dataset))
+    n, dim = X.shape
+    n_dev = mesh.shape[axis]
+    expects(n % n_dev == 0, "rows must divide the mesh axis (pad first)")
+
+    if centers is None:
+        centers = _flat._train_centers(params, X)
+
+    labels = kmeans_balanced.predict(
+        KMeansBalancedParams(metric=params.metric), centers, X)
+    labels_h = np.asarray(labels)
+    ids = jnp.arange(n, dtype=validate_idx_dtype(params.idx_dtype))
+    data, idx, sizes = _shard_pack(mesh, axis, X, labels_h, ids,
+                                   params.n_lists)
+    return ShardedIvfFlat(metric=params.metric, centers=centers, data=data,
+                          indices=idx, list_sizes=sizes, axis=axis)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "k", "n_probes",
+                              "inner_is_l2", "sqrt"))
+def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
+                             mesh, axis, k, n_probes, inner_is_l2, sqrt):
+    # jit around shard_map is load-bearing: un-jitted shard_map runs in the
+    # eager SPMD interpreter (~10x slower, measured on the CPU mesh).
+    n_dev = mesh.shape[axis]
+
+    def body(data_l, idx_l, sz_l, centers_r, q):
+        data_l, idx_l, sz_l = data_l[0], idx_l[0], sz_l[0]
+        probe_ids = _flat._coarse_probe(q, centers_r, n_probes, inner_is_l2)
+        norms = jnp.sum(data_l * data_l, axis=2) if inner_is_l2 else None
+        # Per-device top-k is bounded by this shard's slot capacity.
+        kk = min(k, data_l.shape[0] * data_l.shape[1])
+        d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
+                                 inner_is_l2, sqrt, probe_ids=probe_ids)
+        all_d = lax.all_gather(d, axis, axis=1, tiled=True)  # (q, n_dev*k)
+        all_i = lax.all_gather(i, axis, axis=1, tiled=True)
+        keys = -all_d if inner_is_l2 else all_d
+        _, pos = lax.top_k(keys, min(k, n_dev * d.shape[1]))
+        return (jnp.take_along_axis(all_d, pos, axis=1),
+                jnp.take_along_axis(all_i, pos, axis=1))
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P()),
+        out_specs=(P(), P()))
+    return fn(data, indices, sizes, centers, Q)
+
+
+def sharded_ivf_flat_search(
+    mesh: Mesh, params: "_flat.SearchParams", index: ShardedIvfFlat,
+    queries, k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search the sharded index; returns replicated global-id results,
+    identical to the single-device index built from the same centers."""
+    Q = _flat._as_float(_flat.as_array(queries))
+    expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
+    n_probes = min(params.n_probes, index.centers.shape[0])
+    # Clamp by the GLOBAL capacity (n_dev shards merge their top-k), the
+    # same contract as the single-device search's capacity clamp.
+    k = min(k, index.indices.shape[0] * index.indices.shape[1]
+            * index.indices.shape[2])
+    inner_is_l2 = index.metric != DistanceType.InnerProduct
+    sqrt = index.metric in (DistanceType.L2SqrtExpanded,
+                            DistanceType.L2SqrtUnexpanded)
+    return _sharded_flat_search_jit(
+        index.data, index.indices, index.list_sizes, index.centers, Q,
+        mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
+        inner_is_l2=inner_is_l2, sqrt=sqrt)
+
+
+def sharded_ivf_pq_build(
+    mesh: Mesh, params: "_pq.IndexParams", dataset, axis: str = "data",
+    model: Optional["_pq.Index"] = None,
+) -> ShardedIvfPq:
+    """Build an IVF-PQ with codes sharded over ``mesh[axis]``. The coarse
+    centers / rotation / codebooks come from ``model`` (an empty Index from
+    ivf_pq.build with add_data_on_build=False) or are trained here the
+    same way; every shard encodes its rows against the shared model."""
+    X = _pq._as_float(_pq.as_array(dataset))
+    n, dim = X.shape
+    n_dev = mesh.shape[axis]
+    expects(n % n_dev == 0, "rows must divide the mesh axis (pad first)")
+
+    if model is None:
+        import dataclasses
+
+        model = _pq.build(dataclasses.replace(params, add_data_on_build=False),
+                          X)
+
+    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
+    labels = kmeans_balanced.predict(kb, model.centers, X)
+    res = _pq._residuals(X, labels, model.centers, model.rotation_matrix,
+                         model.pq_dim)
+    if model.codebook_kind == _pq.CodebookGen.PER_SUBSPACE:
+        codes = _pq._encode(res, model.pq_centers)
+    else:
+        codes = _pq._encode_per_cluster(res, labels, model.pq_centers)
+    codes = _pq.pack_codes(codes, model.pq_bits)
+
+    ids = jnp.arange(n, dtype=model.indices.dtype)
+    packed, idx, sizes = _shard_pack(mesh, axis, codes, np.asarray(labels),
+                                     ids, model.n_lists)
+    return ShardedIvfPq(
+        metric=model.metric, codebook_kind=model.codebook_kind,
+        centers=model.centers, rotation_matrix=model.rotation_matrix,
+        pq_centers=model.pq_centers, pq_codes=packed.astype(jnp.uint8),
+        indices=idx, list_sizes=sizes, pq_bits=model.pq_bits,
+        pq_dim=model.pq_dim, axis=axis)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "k", "n_probes", "is_ip",
+                              "per_cluster", "pq_dim", "pq_bits", "sqrt",
+                              "lut_dtype"))
+def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
+                           mesh, axis, k, n_probes, is_ip, per_cluster,
+                           pq_dim, pq_bits, sqrt, lut_dtype):
+    n_dev = mesh.shape[axis]
+
+    def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q):
+        codes_l, idx_l, sz_l = codes_l[0], idx_l[0], sz_l[0]
+        probe_ids = _pq._select_clusters((q, centers_r), n_probes, is_ip)
+        rotq = jnp.matmul(q, rot_r.T, precision=lax.Precision.HIGHEST)
+        centers_rot = jnp.matmul(centers_r, rot_r.T,
+                                 precision=lax.Precision.HIGHEST)
+        kk = min(k, codes_l.shape[0] * codes_l.shape[1])
+        d, i = _pq._pq_probe_scan(
+            rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
+            lut_dtype, pq_dim, pq_bits,
+            pq_centers=books_r, centers_rot=centers_rot)
+        all_d = lax.all_gather(d, axis, axis=1, tiled=True)
+        all_i = lax.all_gather(i, axis, axis=1, tiled=True)
+        keys = all_d if is_ip else -all_d
+        _, pos = lax.top_k(keys, min(k, n_dev * d.shape[1]))
+        out_d = jnp.take_along_axis(all_d, pos, axis=1)
+        if sqrt:
+            out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
+        return out_d, jnp.take_along_axis(all_i, pos, axis=1)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P()),
+        out_specs=(P(), P()))
+    return fn(codes, indices, sizes, centers, rot, books, Q)
+
+
+def sharded_ivf_pq_search(
+    mesh: Mesh, params: "_pq.SearchParams", index: ShardedIvfPq,
+    queries, k: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Search the sharded PQ index (LUT scan per shard + collective merge);
+    returns replicated global-id results."""
+    Q = _pq._as_float(_pq.as_array(queries))
+    expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
+    n_probes = min(params.n_probes, index.centers.shape[0])
+    k = min(k, index.indices.shape[0] * index.indices.shape[1]
+            * index.indices.shape[2])
+    is_ip = index.metric == DistanceType.InnerProduct
+    return _sharded_pq_search_jit(
+        index.pq_codes, index.indices, index.list_sizes, index.centers,
+        index.rotation_matrix, index.pq_centers, Q,
+        mesh=mesh, axis=index.axis, k=k, n_probes=n_probes, is_ip=is_ip,
+        per_cluster=index.codebook_kind == _pq.CodebookGen.PER_CLUSTER,
+        pq_dim=index.pq_dim, pq_bits=index.pq_bits,
+        sqrt=index.metric == DistanceType.L2SqrtExpanded,
+        lut_dtype=jnp.dtype(params.lut_dtype))

@@ -22,6 +22,12 @@ def round_up_safe(v: int, multiple: int) -> int:
     return ceildiv(v, multiple) * multiple
 
 
+def next_pow2(v: int) -> int:
+    """Smallest power of two ≥ v (v ≤ 0 → 1) — the amortized list-capacity
+    growth policy shared by the IVF packers."""
+    return 1 << max(int(v) - 1, 0).bit_length()
+
+
 def round_down_safe(v: int, multiple: int) -> int:
     """Round down to a multiple (ref: raft::round_down_safe)."""
     return (v // multiple) * multiple

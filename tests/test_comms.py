@@ -89,6 +89,51 @@ class TestShardedAlgos:
                                    rtol=1e-3, atol=1e-3)
         np.testing.assert_allclose(float(inertia), float(inertia_ref), rtol=1e-3)
 
+    def test_sharded_ivf_flat_matches_single_device(self, mesh, rng):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        db = rng.normal(size=(2048, 24)).astype(np.float32)
+        q = rng.normal(size=(40, 24)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        single = ivf_flat.build(params, db)
+        sharded = sharded_ivf_flat_build(mesh, params, db,
+                                         centers=single.centers)
+        sp = ivf_flat.SearchParams(n_probes=8, engine="scan")
+        sd, si = ivf_flat.search(sp, single, q, 10)
+        dd, di = sharded_ivf_flat_search(mesh, sp, sharded, q, 10)
+        si, di = np.asarray(si), np.asarray(di)
+        # Same shared centers -> identical probed candidate set; results
+        # must agree up to distance ties.
+        agree = np.mean([len(np.intersect1d(si[r], di[r])) / 10
+                         for r in range(len(q))])
+        assert agree > 0.999, agree
+        np.testing.assert_allclose(np.sort(np.asarray(dd), 1),
+                                   np.sort(np.asarray(sd), 1), atol=1e-4)
+
+    def test_sharded_ivf_pq_matches_single_device(self, mesh, rng):
+        import dataclasses
+
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import (sharded_ivf_pq_build,
+                                       sharded_ivf_pq_search)
+
+        db = rng.normal(size=(2048, 32)).astype(np.float32)
+        q = rng.normal(size=(40, 32)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        model = ivf_pq.build(
+            dataclasses.replace(params, add_data_on_build=False), db)
+        single = ivf_pq.extend(model, db)
+        sharded = sharded_ivf_pq_build(mesh, params, db, model=model)
+        sp = ivf_pq.SearchParams(n_probes=8, engine="scan")
+        sd, si = ivf_pq.search(sp, single, q, 10)
+        dd, di = sharded_ivf_pq_search(mesh, sp, sharded, q, 10)
+        si, di = np.asarray(si), np.asarray(di)
+        agree = np.mean([len(np.intersect1d(si[r], di[r])) / 10
+                         for r in range(len(q))])
+        assert agree > 0.98, agree
+
     def test_graft_entry_dryrun(self):
         import __graft_entry__ as ge
 
