@@ -127,7 +127,8 @@ def single_linkage(
     controls kNN-graph width k = c like the reference's knn connectivity
     parameter). Returns a :class:`LinkageOutput`.
     """
-    from raft_tpu.sparse.neighbors import connect_components, knn_graph
+    from raft_tpu.sparse.neighbors import (connect_components,
+                                           connected_components, knn_graph)
     from raft_tpu.sparse.solver import mst as mst_solver
 
     X = np.asarray(X, np.float32)
@@ -149,10 +150,12 @@ def single_linkage(
         w = np.asarray(g.vals)
         # Connected-components fixup: union extra cross-component edges
         # until the graph is connected (ref: detail/connectivities.cuh +
-        # connect_components loop).
+        # connect_components loop). Component labels and the masked
+        # cross-component NN both run on device; only the O(1)-size
+        # "is it connected yet" probe reaches the host.
         for _ in range(int(np.ceil(np.log2(max(n, 2)))) + 1):
-            comp = _components(rows, cols, n)
-            if len(np.unique(comp)) == 1:
+            comp = np.asarray(connected_components(rows, cols, n))
+            if (comp == comp[0]).all():
                 break
             extra = connect_components(X, comp, metric=metric)
             rows = np.concatenate([rows, np.asarray(extra.rows)])
@@ -169,18 +172,3 @@ def single_linkage(
         sizes=sizes, n_clusters=n_clusters)
 
 
-def _components(rows, cols, n: int) -> np.ndarray:
-    """Host union-find connected components of an edge list."""
-    parent = np.arange(n)
-
-    def find(a):
-        while parent[a] != a:
-            parent[a] = parent[parent[a]]
-            a = parent[a]
-        return a
-
-    for a, b in zip(rows, cols):
-        ra, rb = find(int(a)), find(int(b))
-        if ra != rb:
-            parent[ra] = rb
-    return np.array([find(i) for i in range(n)])

@@ -28,8 +28,8 @@ from raft_tpu.sparse.types import CSR
 from raft_tpu.sparse.linalg import spmv
 
 
-@functools.partial(jax.jit, static_argnums=(4,))
-def _lanczos_basis(indptr_rows, indices, vals, v0, ncv: int):
+@functools.partial(jax.jit, static_argnums=(5,))
+def _lanczos_basis(indptr_rows, indices, vals, v0, rkey, ncv: int):
     """Build the ncv-step Krylov basis and tridiagonal coefficients with
     full reorthogonalization. Returns (V (ncv, n), alpha (ncv,), beta (ncv,))
     where beta[i] links step i to i+1.
@@ -46,8 +46,9 @@ def _lanczos_basis(indptr_rows, indices, vals, v0, ncv: int):
         return jax.ops.segment_sum(prod, indptr_rows, num_segments=n)
 
     v0 = v0 / jnp.linalg.norm(v0)
-    # Pre-drawn restart directions (deterministic; one per step).
-    rkey = jax.random.key(12345)
+    # Pre-drawn restart directions, one per step — derived from the
+    # caller's seed so runs are reproducible end-to-end (the reference's
+    # seeded computeSmallestEigenvectors contract).
     R = jax.random.normal(rkey, (ncv, n), v0.dtype)
 
     def step(carry, inp):
@@ -93,7 +94,8 @@ def _eigs(csr: CSR, n_components: int, ncv: Optional[int], seed: int,
     v0 = jax.random.normal(key, (n,), jnp.float32)
     rows = csr.row_ids()
     V, alphas, betas = _lanczos_basis(rows, csr.indices,
-                                      csr.vals.astype(jnp.float32), v0, ncv)
+                                      csr.vals.astype(jnp.float32), v0,
+                                      jax.random.fold_in(key, 1), ncv)
     # Tridiagonal T: diag(alphas) + offdiag(betas[:-1]).
     T = (jnp.diag(alphas)
          + jnp.diag(betas[:-1], 1)

@@ -15,6 +15,7 @@ mst_solver_inl.cuh) so the MST is unique and symmetric duplicates agree.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Tuple
 
@@ -38,9 +39,11 @@ class Graph_COO:
     n_edges: int
 
 
+@functools.partial(jax.jit, static_argnums=(3, 4))
 def _boruvka(rows, cols, weights, n_vertices: int, max_rounds: int):
     """One jitted Borůvka solve over a static edge list. Returns per-edge
-    'in MST' flags. Invalid edges carry weight +inf."""
+    'in MST' flags. Invalid edges carry weight +inf. (The jit wrapper is
+    load-bearing: a bare lax.while_loop re-traces on every call.)"""
     n_edges = rows.shape[0]
     edge_ids = jnp.arange(n_edges, dtype=jnp.int32)
 
