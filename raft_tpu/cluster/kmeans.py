@@ -33,6 +33,7 @@ from raft_tpu.cluster.kmeans_types import InitMethod, KMeansParams
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
+from raft_tpu.core.nvtx import traced
 
 
 def _as_float(x) -> jax.Array:
@@ -72,6 +73,7 @@ def min_cluster_distance(X, centroids, metric=DistanceType.L2Expanded) -> jax.Ar
     return d
 
 
+@traced
 def cluster_cost(X, centroids, metric=DistanceType.L2Expanded) -> jax.Array:
     """Total inertia Σ min-distance (ref: raft::cluster::kmeans::cluster_cost,
     cluster/kmeans.cuh; runtime cpp/src/cluster/cluster_cost.cuh; pylibraft
@@ -210,6 +212,7 @@ def _lloyd(X, centroids0, sample_weight, max_iter: int, tol: float,
     return centroids, labels, inertia, it
 
 
+@traced
 def fit(
     params: KMeansParams,
     X,
@@ -246,6 +249,7 @@ def fit(
     return best
 
 
+@traced
 def predict(
     params: KMeansParams, centroids, X, normalize_weight: bool = True, sample_weight=None
 ) -> Tuple[jax.Array, jax.Array]:
@@ -260,6 +264,7 @@ def predict(
     return labels, jnp.sum(dists)
 
 
+@traced
 def fit_predict(params: KMeansParams, X, sample_weight=None, centroids_init=None):
     """Ref: raft::cluster::kmeans::fit_predict (cluster/kmeans.cuh:214).
     Returns ``(centroids, labels, inertia, n_iter)``."""
@@ -268,12 +273,14 @@ def fit_predict(params: KMeansParams, X, sample_weight=None, centroids_init=None
     return centroids, labels, inertia, it
 
 
+@traced
 def transform(params: KMeansParams, centroids, X) -> jax.Array:
     """(n, k) matrix of sample-to-centroid distances (ref:
     raft::cluster::kmeans::transform, cluster/kmeans.cuh:243)."""
     return pairwise_distance_fn(_as_float(X), _as_float(centroids), metric=params.metric)
 
 
+@traced
 def find_k(
     X,
     kmax: int,

@@ -47,6 +47,7 @@ from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 # Threshold ratio below which a cluster is considered under-populated and
 # eligible for re-seeding (ref: adjust_centers uses average/4 as the small-
@@ -74,6 +75,7 @@ def _labels(X, centroids, metric: DistanceType) -> jax.Array:
             else jnp.argmax(d, axis=1)).astype(jnp.int32)
 
 
+@traced
 def predict(
     params: KMeansBalancedParams, centroids, X
 ) -> jax.Array:
@@ -263,6 +265,7 @@ def _hierarchical_fine_em(X, meso_labels, owner, seed_slots, key,
     return lax.fori_loop(0, n_iters, body, centroids0)
 
 
+@traced
 def build_clusters(
     params: KMeansBalancedParams, X, n_clusters: int, key=None
 ) -> jax.Array:
@@ -287,6 +290,7 @@ def build_clusters(
     return _balanced_em(X, centroids0, params.n_iters, n_clusters)
 
 
+@traced
 def fit(
     params: KMeansBalancedParams, X, n_clusters: int
 ) -> jax.Array:
@@ -345,6 +349,7 @@ def fit(
     return _balanced_em(X, centroids, max(2, params.n_iters // 2), n_clusters)
 
 
+@traced
 def fit_predict(
     params: KMeansBalancedParams, X, n_clusters: int
 ) -> Tuple[jax.Array, jax.Array]:

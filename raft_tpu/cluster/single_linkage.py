@@ -27,6 +27,7 @@ import numpy as np
 
 from raft_tpu.core.error import expects
 from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.core.nvtx import traced
 
 # NOTE: sparse modules are imported lazily inside single_linkage() —
 # cluster ← neighbors ← sparse.neighbors would otherwise form an import
@@ -114,6 +115,7 @@ def _dendrogram(src, dst, w, n: int, n_clusters: int):
     return labels.astype(np.int32), children[:merge], distances[:merge], sizes[:merge]
 
 
+@traced
 def single_linkage(
     X,
     n_clusters: int,

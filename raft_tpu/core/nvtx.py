@@ -10,8 +10,9 @@ public API entry — is kept throughout raft_tpu.
 from __future__ import annotations
 
 import contextlib
+import functools
 import threading
-from typing import Iterator, List
+from typing import Iterator, List, Optional
 
 import jax
 
@@ -26,9 +27,36 @@ def _stack() -> List[object]:
 
 @contextlib.contextmanager
 def range_scope(name: str, domain: str = "raft_tpu") -> Iterator[None]:
-    """Scoped trace range (ref: common::nvtx::range<domain>, nvtx.hpp:48)."""
-    with jax.profiler.TraceAnnotation(f"{domain}::{name}"):
+    """Scoped trace range (ref: common::nvtx::range<domain>, nvtx.hpp:48).
+
+    Opens both a host-side ``TraceAnnotation`` (Perfetto host timeline) and
+    an XLA ``named_scope`` (HLO op-name prefix, so the *device* timeline
+    segments by component too)."""
+    label = f"{domain}::{name}"
+    with jax.profiler.TraceAnnotation(label), jax.named_scope(label):
         yield
+
+
+def traced(fn=None, *, name: Optional[str] = None, domain: str = "raft_tpu"):
+    """Decorator applying the reference's profiling convention — a range at
+    every public API entry (ref: NVTX call sites like
+    neighbors/detail/ivf_pq_build.cuh:1080, matrix/detail/select_k.cuh:79).
+
+    The label defaults to ``<leaf module>.<function>`` so traces read like
+    ``raft_tpu::ivf_pq.search``.
+    """
+
+    def deco(f):
+        label = name or f"{f.__module__.rsplit('.', 1)[-1]}.{f.__name__}"
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            with range_scope(label, domain):
+                return f(*args, **kwargs)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
 
 
 def push_range(name: str, domain: str = "raft_tpu") -> None:

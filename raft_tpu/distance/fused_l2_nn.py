@@ -23,12 +23,14 @@ from raft_tpu.core.mdarray import as_array
 from raft_tpu.core.error import expects
 from raft_tpu.linalg.blas import DEFAULT_PRECISION
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 # y-tile size: large enough to keep the MXU busy, small enough that the
 # (m, tile) epilogue stays in VMEM for typical m blocks.
 _TILE_N = 2048
 
 
+@traced
 def fused_l2_nn_min_reduce(
     x,
     y,
@@ -96,6 +98,7 @@ def fused_l2_nn_min_reduce(
     return (jnp.sqrt(best_d) if sqrt else best_d), best_i
 
 
+@traced
 def fused_l2_nn_argmin(x, y, sqrt: bool = False) -> jax.Array:
     """Arg-min only (ref: MinReduceOp variant / runtime
     ``fused_l2_nn_min_arg``, cpp/src/distance/fused_l2_min_arg.cu)."""

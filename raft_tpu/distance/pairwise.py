@@ -34,6 +34,7 @@ from raft_tpu.core.error import expects
 from raft_tpu.linalg.blas import DEFAULT_PRECISION
 from raft_tpu.distance.distance_types import DistanceType, resolve_metric
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 # Element budget for the (bx, n, k) broadcast intermediate of unexpanded
 # metrics (~64 MB of f32), analogous to the reference's memory-aware tile
@@ -239,6 +240,7 @@ def _blockwise(core, x, y, block_rows: Optional[int] = None) -> jax.Array:
 # Public API
 
 
+@traced
 def distance(
     x,
     y,
@@ -310,6 +312,7 @@ def distance(
     raise ValueError(f"unsupported metric {metric!r}")
 
 
+@traced
 def pairwise_distance(
     x,
     y,

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 
 class SelectMethod(enum.Enum):
@@ -99,6 +100,7 @@ def _two_phase_top_k(values, k, select_min, chunk=_CHUNK):
     return sel, idx
 
 
+@traced
 def select_k(
     values,
     k: int,

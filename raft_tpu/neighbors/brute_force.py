@@ -35,6 +35,7 @@ from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 # Database-tile length for the scan: large enough to keep the MXU busy,
 # small enough that the (n_queries, tile) distance block plus the (n_queries,
@@ -125,6 +126,7 @@ def _tiled_knn_l2(queries, db, k: int, sqrt: bool, tile_db: int, inner_is_l2: bo
     return best_d, best_i
 
 
+@traced
 def tiled_brute_force_knn(
     queries,
     db,
@@ -187,6 +189,7 @@ def tiled_brute_force_knn(
     return best_d, best_i
 
 
+@traced
 def knn_merge_parts(
     in_keys,
     in_values,
@@ -218,6 +221,7 @@ def knn_merge_parts(
     return out_k, out_v
 
 
+@traced
 def knn(
     index: Union[jax.Array, Sequence[jax.Array]],
     queries,
@@ -286,6 +290,7 @@ def knn(
                            translations=offsets)
 
 
+@traced
 def fused_l2_knn(index, queries, k: int, sqrt: bool = False):
     """L2-only fused kNN (ref: raft::neighbors::brute_force::fused_l2_knn,
     neighbors/brute_force.cuh → fused_l2_knn.cuh)."""

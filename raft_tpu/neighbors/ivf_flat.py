@@ -44,6 +44,7 @@ from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv, next_pow2
+from raft_tpu.core.nvtx import traced
 
 
 @dataclass
@@ -201,6 +202,7 @@ def _coarse_probe(Q: jax.Array, centers: jax.Array, n_probes: int,
     return probe_ids
 
 
+@traced
 def build(params: IndexParams, dataset, handle=None) -> Index:
     """Train centers (balanced k-means on a subsample) and fill the lists.
 
@@ -231,6 +233,7 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     return index
 
 
+@traced
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Append vectors to the index (re-pack with capacity growth).
 
@@ -508,6 +511,7 @@ def _bucketed_probe_scan(
     return best_d, best_i
 
 
+@traced
 def search(
     params: SearchParams, index: Index, queries, k: int,
     handle=None,
@@ -563,6 +567,7 @@ def search(
 SERIALIZATION_VERSION = 3
 
 
+@traced
 def save(filename: str, index: Index) -> None:
     """Ref: ivf_flat::serialize / pylibraft save (neighbors/ivf_flat.pyx)."""
     np.savez(
@@ -578,6 +583,7 @@ def save(filename: str, index: Index) -> None:
     )
 
 
+@traced
 def load(filename: str) -> Index:
     """Ref: ivf_flat::deserialize / pylibraft load."""
     if not filename.endswith(".npz"):

@@ -57,6 +57,7 @@ from raft_tpu.neighbors.ivf_flat import (
 )
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv, next_pow2
+from raft_tpu.core.nvtx import traced
 
 
 class CodebookGen(enum.Enum):
@@ -443,6 +444,7 @@ def _residuals(X, labels, centers, rot, pq_dim: int) -> jax.Array:
     return rr.reshape(n, pq_dim, rot.shape[0] // pq_dim)
 
 
+@traced
 def build(params: IndexParams, dataset, handle=None) -> Index:
     """Train the index (ref: ivf_pq::build → detail/ivf_pq_build.cuh:1074):
     subsample → balanced kmeans coarse centers → rotated residuals →
@@ -523,6 +525,7 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     return index
 
 
+@traced
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Encode + append rows (ref: ivf_pq::extend, ivf_pq_build.cuh:873 →
     process_and_fill_codes:724). Existing codes are kept; storage re-packs
@@ -687,6 +690,7 @@ def _pq_probe_scan(
     return best_d, best_i
 
 
+@traced
 def search(
     params: SearchParams, index: Index, queries, k: int, handle=None,
 ) -> Tuple[jax.Array, jax.Array]:
@@ -763,6 +767,7 @@ def search(
 SERIALIZATION_VERSION = 4
 
 
+@traced
 def save(filename: str, index: Index) -> None:
     """Ref: ivf_pq::serialize / pylibraft save (ivf_pq.pyx:719)."""
     np.savez(
@@ -782,6 +787,7 @@ def save(filename: str, index: Index) -> None:
     )
 
 
+@traced
 def load(filename: str) -> Index:
     """Ref: ivf_pq::deserialize / pylibraft load (ivf_pq.pyx:765)."""
     if not filename.endswith(".npz"):

@@ -21,8 +21,10 @@ from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
+from raft_tpu.core.nvtx import traced
 
 
+@traced
 def refine(
     dataset,
     queries,

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.nvtx import traced
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -95,6 +96,7 @@ def _auction_solve(cost, max_rounds: int):
     return col_of_row
 
 
+@traced
 def lap(cost, max_rounds: int = 0) -> Tuple[jax.Array, jax.Array]:
     """Solve min-cost assignment. Returns ``(row_assignment (n,) int32,
     total_cost scalar)``.

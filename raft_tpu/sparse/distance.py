@@ -48,6 +48,7 @@ from raft_tpu.distance.pairwise import distance as dense_distance
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.sparse.types import CSR
 from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.core.nvtx import traced
 
 # Densify-and-fuse below this operand footprint (bytes of one dense side).
 _DENSE_BYTES = 64 * 1024 * 1024
@@ -355,6 +356,7 @@ def _pick_dchunk(d: int, b: int) -> int:
     return int(min(d, dc))
 
 
+@traced
 def pairwise_distance(
     x: CSR, y: CSR,
     metric: Union[str, DistanceType] = DistanceType.L2Expanded,
@@ -392,6 +394,7 @@ def pairwise_distance(
     return jnp.concatenate(out, axis=0)[:m, :n]
 
 
+@traced
 def knn_blocked(
     idx: CSR, query: CSR, k: int,
     metric: Union[str, DistanceType] = DistanceType.L2Expanded,

@@ -21,8 +21,10 @@ from raft_tpu.neighbors.brute_force import tiled_brute_force_knn
 from raft_tpu.sparse.types import COO, CSR
 from raft_tpu.sparse.distance import knn_blocked
 from raft_tpu.util.pow2 import ceildiv as _ceildiv
+from raft_tpu.core.nvtx import traced
 
 
+@traced
 def brute_force_knn(
     idx: CSR, query: CSR, k: int,
     metric: Union[str, DistanceType] = DistanceType.L2Expanded,
@@ -37,6 +39,7 @@ def brute_force_knn(
     return knn_blocked(idx, query, k, metric=metric, metric_arg=metric_arg)
 
 
+@traced
 def knn_graph(
     X, k: int,
     metric: Union[str, DistanceType] = DistanceType.L2SqrtExpanded,
@@ -138,6 +141,7 @@ def _masked_cross_nn(Xc, labc, X, lab, sqrt: bool):
     return (jnp.sqrt(bd) if sqrt else bd), bi
 
 
+@traced
 def connect_components(
     X, labels, metric: DistanceType = DistanceType.L2SqrtExpanded,
 ) -> COO:

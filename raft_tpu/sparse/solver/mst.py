@@ -26,6 +26,7 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.sparse.types import COO, CSR
+from raft_tpu.core.nvtx import traced
 
 
 @dataclass
@@ -101,6 +102,7 @@ def _boruvka(rows, cols, weights, n_vertices: int, max_rounds: int):
     return in_mst, color
 
 
+@traced
 def mst(
     rows, cols, weights, n_vertices: int,
 ) -> Graph_COO:

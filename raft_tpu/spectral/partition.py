@@ -30,6 +30,7 @@ from raft_tpu.sparse.solver import (
     lanczos_largest_eigenpairs,
     lanczos_smallest_eigenpairs,
 )
+from raft_tpu.core.nvtx import traced
 
 
 @dataclass
@@ -53,6 +54,7 @@ class ClusterSolverConfig:
     seed: int = 123456
 
 
+@traced
 def partition(
     adj: CSR,
     n_clusters: int,
@@ -107,6 +109,7 @@ def analyze_partition(adj: CSR, labels, n_clusters: int) -> Tuple[float, float]:
     return edge_cut, cost
 
 
+@traced
 def modularity_maximization(
     adj: CSR,
     n_clusters: int,

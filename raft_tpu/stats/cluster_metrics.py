@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.stats.classification import contingency_matrix
+from raft_tpu.core.nvtx import traced
 
 
 def _contingency(a, b, n_classes: Optional[int] = None) -> jax.Array:
@@ -139,6 +140,7 @@ def kl_divergence(modeled_pdf, candidate_pdf) -> jax.Array:
     return jnp.sum(jnp.where(p > 0, p * jnp.log(ratio), 0.0))
 
 
+@traced
 def silhouette_score(
     X,
     labels,
@@ -200,6 +202,7 @@ def silhouette_score(
     return jnp.mean(sil)
 
 
+@traced
 def trustworthiness_score(
     X,
     X_embedded,

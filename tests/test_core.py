@@ -142,3 +142,39 @@ class TestUtil:
     def test_pow2_rejects_non_pow2(self):
         with pytest.raises(ValueError):
             Pow2(100)
+
+
+class TestTracing:
+    """Profiling convention (ref: NVTX range at every public entry,
+    core/nvtx.hpp:48-90 + call sites like ivf_pq_build.cuh:1080)."""
+
+    def test_traced_preserves_semantics(self):
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.core.nvtx import traced
+
+        @traced
+        def f(x):
+            return x * 2
+
+        assert f.__name__ == "f"
+        assert int(f(jnp.asarray(3))) == 6
+        # Also under jit (named_scope path).
+        assert int(jax.jit(f)(jnp.asarray(4))) == 8
+
+    def test_range_scope_nesting(self):
+        from raft_tpu.core.nvtx import pop_range, push_range, range_scope
+
+        with range_scope("outer"):
+            push_range("inner")
+            pop_range()
+
+    def test_public_entries_are_traced(self):
+        # Spot-check the convention at the VERDICT-named surfaces.
+        from raft_tpu.matrix.select_k import select_k
+        from raft_tpu.neighbors import ivf_flat, ivf_pq
+        from raft_tpu.cluster import kmeans_balanced
+
+        for fn in (select_k, ivf_flat.build, ivf_flat.search, ivf_pq.build,
+                   ivf_pq.search, kmeans_balanced.fit):
+            assert fn.__wrapped__ is not None, fn
