@@ -1,0 +1,231 @@
+"""ANN recall-threshold grid, mirroring the reference's parameterized
+config lists and per-config min_recall values.
+
+Ref: cpp/test/neighbors/ann_ivf_pq.cuh — ``enum_variety`` grid (:425-495)
+with per-case thresholds (0.79–0.86), the IP-scaled variants (:508-525,
+×0.94, ×0.90 for u8 LUTs), and the conservative bound formula (:257-265:
+``min_recall = n_probes/n_lists`` adjusted by
+``erfc(0.05·lpf/max(min_recall, 0.5))`` for low-precision codes);
+cpp/test/neighbors/ann_ivf_flat.cuh:111,146-153 — ``min_recall =
+nprobe/nlist`` per dtype {float, int8, uint8}. Data matches the
+reference generators: uniform(0.1, 2.0) floats / uniformInt(1, 20) ints.
+
+Recall is evaluated tie-aware like eval_neighbours (ann_utils.cuh:121-162):
+a returned neighbor counts if its id is in the ground truth OR its distance
+ties the ground-truth k-th distance within eps.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+N_DB, N_QUERIES, DIM, K = 4096, 1024, 64, 32
+N_LISTS = 32          # max(32, min(1024, n/128)) at n=4096 (ivf_pq_inputs)
+N_PROBES = 20         # ivf_pq::search_params default
+
+
+def _data(dtype):
+    rng = np.random.default_rng(42)
+    if dtype == np.float32:
+        db = rng.uniform(0.1, 2.0, (N_DB, DIM)).astype(np.float32)
+        q = rng.uniform(0.1, 2.0, (N_QUERIES, DIM)).astype(np.float32)
+    else:
+        db = rng.integers(1, 21, (N_DB, DIM)).astype(dtype)
+        q = rng.integers(1, 21, (N_QUERIES, DIM)).astype(dtype)
+    return db, q
+
+
+def _ground_truth(db, q, metric):
+    d, i = brute_force.knn(db.astype(np.float32), q.astype(np.float32), K,
+                           metric=metric)
+    return np.asarray(d), np.asarray(i)
+
+
+def _recall_with_ties(ids, dists, gt_ids, gt_dists, select_min, eps=1e-3):
+    """eval_neighbours semantics (ann_utils.cuh:121-162)."""
+    hits = 0
+    for r in range(gt_ids.shape[0]):
+        gtset = set(gt_ids[r].tolist())
+        edge = gt_dists[r][-1]
+        for c in range(ids.shape[1]):
+            tie = (dists[r][c] <= edge + eps if select_min
+                   else dists[r][c] >= edge - eps)
+            if ids[r][c] in gtset or tie:
+                hits += 1
+    return hits / gt_ids.size
+
+
+@pytest.fixture(scope="module")
+def f32_l2():
+    db, q = _data(np.float32)
+    gt_d, gt_i = _ground_truth(db, q, DistanceType.L2Expanded)
+    return db, q, gt_d, gt_i
+
+
+@pytest.fixture(scope="module")
+def f32_ip():
+    db, q = _data(np.float32)
+    gt_d, gt_i = _ground_truth(db, q, DistanceType.InnerProduct)
+    return db, q, gt_d, gt_i
+
+
+def _run_pq(db, q, metric, idx_kw, search_kw):
+    params = ivf_pq.IndexParams(
+        n_lists=N_LISTS, metric=metric, kmeans_trainset_fraction=1.0,
+        **idx_kw)
+    index = ivf_pq.build(params, db)
+    sp = ivf_pq.SearchParams(n_probes=N_PROBES, engine="scan", **search_kw)
+    d, i = ivf_pq.search(sp, index, q.astype(np.float32), K)
+    return np.asarray(d), np.asarray(i)
+
+
+# enum_variety (ann_ivf_pq.cuh:425-495): (name, index_params, search_params,
+# min_recall)
+ENUM_VARIETY = [
+    ("cluster_default",
+     dict(codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER), {}, 0.86),
+    ("subspace_default",
+     dict(codebook_kind=ivf_pq.CodebookGen.PER_SUBSPACE), {}, 0.86),
+    ("cluster_pq4",
+     dict(codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER, pq_bits=4), {}, 0.79),
+    ("cluster_pq5",
+     dict(codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER, pq_bits=5), {}, 0.83),
+    ("pq6", dict(pq_bits=6), {}, 0.84),
+    ("pq7", dict(pq_bits=7), {}, 0.85),
+    ("pq8", dict(pq_bits=8), {}, 0.86),
+    ("random_rotation", dict(force_random_rotation=True), {}, 0.86),
+    ("lut_f32", {}, dict(lut_dtype=jnp.float32), 0.86),
+    ("lut_bf16", {}, dict(lut_dtype=jnp.bfloat16), 0.86),
+    ("lut_u8", {}, dict(lut_dtype=jnp.uint8), 0.84),
+]
+
+
+class TestIvfPqEnumVarietyL2:
+    @pytest.mark.parametrize("name,idx_kw,search_kw,min_recall",
+                             ENUM_VARIETY, ids=[c[0] for c in ENUM_VARIETY])
+    def test_l2(self, f32_l2, name, idx_kw, search_kw, min_recall):
+        db, q, gt_d, gt_i = f32_l2
+        d, i = _run_pq(db, q, DistanceType.L2Expanded, idx_kw, search_kw)
+        rec = _recall_with_ties(i, d, gt_i, gt_d, select_min=True)
+        assert rec >= min_recall, (name, rec, min_recall)
+
+
+# enum_variety_ip (:508-525): thresholds scale by 0.94 (0.90 for u8 LUT).
+ENUM_VARIETY_IP = [
+    ("subspace_default", {}, {}, 0.86 * 0.94),
+    ("cluster_pq4",
+     dict(codebook_kind=ivf_pq.CodebookGen.PER_CLUSTER, pq_bits=4),
+     {}, 0.79 * 0.94),
+    ("lut_u8", {}, dict(lut_dtype=jnp.uint8), 0.84 * 0.90),
+]
+
+
+class TestIvfPqEnumVarietyIP:
+    @pytest.mark.parametrize("name,idx_kw,search_kw,min_recall",
+                             ENUM_VARIETY_IP,
+                             ids=[c[0] for c in ENUM_VARIETY_IP])
+    def test_ip(self, f32_ip, name, idx_kw, search_kw, min_recall):
+        db, q, gt_d, gt_i = f32_ip
+        d, i = _run_pq(db, q, DistanceType.InnerProduct, idx_kw, search_kw)
+        rec = _recall_with_ties(i, d, gt_i, gt_d, select_min=False)
+        assert rec >= min_recall, (name, rec, min_recall)
+
+
+def _conservative_bound(n_probes, n_lists, dim, pq_dim, pq_bits):
+    """ann_ivf_pq.cuh:257-265."""
+    min_recall = n_probes / n_lists
+    lpf = dim * 8 / (pq_dim * pq_bits)
+    return min(math.erfc(0.05 * lpf / max(min_recall, 0.5)), min_recall)
+
+
+class TestIvfPqIntDtypes:
+    """u8/i8 inputs at the formula-based conservative bound (the reference
+    instantiates the grid per dtype via typed shards)."""
+
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int8],
+                             ids=["uint8", "int8"])
+    def test_int_input_recall(self, dtype):
+        db, q = _data(dtype)
+        gt_d, gt_i = _ground_truth(db, q, DistanceType.L2Expanded)
+        d, i = _run_pq(db, q, DistanceType.L2Expanded, {}, {})
+        rec = _recall_with_ties(i, d, gt_i, gt_d, select_min=True)
+        bound = _conservative_bound(N_PROBES, N_LISTS, DIM, DIM // 2, 8)
+        assert rec >= bound, (rec, bound)
+
+
+class TestIvfFlatGrid:
+    """min_recall = nprobe/nlist (ann_ivf_flat.cuh:111) per dtype."""
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.uint8, np.int8],
+                             ids=["float32", "uint8", "int8"])
+    @pytest.mark.parametrize("n_probes", [8, 16, 32])
+    def test_flat_recall_bound(self, dtype, n_probes):
+        db, q = _data(dtype)
+        gt_d, gt_i = _ground_truth(db, q, DistanceType.L2Expanded)
+        params = ivf_flat.IndexParams(n_lists=N_LISTS,
+                                      kmeans_trainset_fraction=1.0)
+        index = ivf_flat.build(params, db)
+        sp = ivf_flat.SearchParams(n_probes=n_probes, engine="scan")
+        d, i = ivf_flat.search(sp, index, q.astype(np.float32), K)
+        rec = _recall_with_ties(np.asarray(i), np.asarray(d), gt_i, gt_d,
+                                select_min=True)
+        assert rec >= n_probes / N_LISTS, (rec, n_probes / N_LISTS)
+
+    def test_ip_metric(self):
+        db, q = _data(np.float32)
+        gt_d, gt_i = _ground_truth(db, q, DistanceType.InnerProduct)
+        params = ivf_flat.IndexParams(n_lists=N_LISTS,
+                                      metric=DistanceType.InnerProduct,
+                                      kmeans_trainset_fraction=1.0)
+        index = ivf_flat.build(params, db)
+        d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16,
+                                                     engine="scan"),
+                               index, q, K)
+        rec = _recall_with_ties(np.asarray(i), np.asarray(d), gt_i, gt_d,
+                                select_min=False)
+        assert rec >= 16 / N_LISTS, rec
+
+
+class TestFewerThanK:
+    """Fewer-than-k / empty-probed-list semantics at larger n (ref: the
+    min_results/max_oob padding check, ann_ivf_pq.cuh:275-295): invalid
+    slots carry id -1 at the worst-distance tail, never duplicate ids."""
+
+    def test_flat_small_lists(self):
+        rng = np.random.default_rng(7)
+        db = rng.uniform(0.1, 2.0, (8192, 32)).astype(np.float32)
+        q = rng.uniform(0.1, 2.0, (64, 32)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=256, kmeans_n_iters=8), db)
+        k = 64  # mean list size is 32, so single-probe searches pad
+        d, i = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=1, engine="scan"), index, q, k)
+        d, i = np.asarray(d), np.asarray(i)
+        for r in range(len(q)):
+            valid = i[r] >= 0
+            # padding is contiguous at the tail and carries +inf distance
+            nv = int(valid.sum())
+            assert valid[:nv].all() and not valid[nv:].any()
+            assert np.isinf(d[r][~valid]).all()
+            ids = i[r][valid]
+            assert len(np.unique(ids)) == len(ids)
+
+    def test_pq_small_lists(self):
+        rng = np.random.default_rng(8)
+        db = rng.uniform(0.1, 2.0, (8192, 32)).astype(np.float32)
+        q = rng.uniform(0.1, 2.0, (64, 32)).astype(np.float32)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=256, pq_dim=16, kmeans_n_iters=8), db)
+        d, i = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=1, engine="scan"), index, q, 64)
+        d, i = np.asarray(d), np.asarray(i)
+        for r in range(len(q)):
+            valid = i[r] >= 0
+            ids = i[r][valid]
+            assert len(np.unique(ids)) == len(ids)
+            assert np.isinf(d[r][~valid]).all()
