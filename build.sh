@@ -16,7 +16,13 @@ do_native() {
   make -C native  # emits raft_tpu/_native/libraft_tpu_host.so
 }
 
+do_style() {
+  # Style/hygiene gate (ref: ci/check_style.sh + cpp/scripts style tools).
+  python ci/check_style.py
+}
+
 do_tests() {
+  do_style
   python -m pytest tests/ -x -q
 }
 
@@ -29,9 +35,10 @@ for target in "$@"; do
   case "$target" in
     clean) do_clean ;;
     native|libraft) do_native ;;
+    style) do_style ;;
     tests) do_tests ;;
     bench) do_bench ;;
     all) do_native; do_tests; do_bench ;;
-    *) echo "unknown target: $target (clean|native|tests|bench|all)"; exit 1 ;;
+    *) echo "unknown target: $target (clean|native|style|tests|bench|all)"; exit 1 ;;
   esac
 done

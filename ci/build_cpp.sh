@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Native runtime build gate (ref: ci/build_cpp.sh) — builds the C++ host
+# runtime shared library and runs its smoke test.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+make -C native
+python -m pytest tests/test_native.py -x -q

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Style / hygiene gate (the role of the reference's ci/check_style.sh +
+cpp/scripts/{run-clang-format.py, include_checker.py} — self-contained
+because the image ships no third-party linter).
+
+Checks, per Python source file:
+  * parses (ast) — no syntax errors reach CI;
+  * no tab indentation, no trailing whitespace, newline at EOF;
+  * no wildcard imports;
+  * raft_tpu library modules carry a reference citation ("Ref:" or
+    "ref:") in the module docstring — the project's parity-evidence
+    convention.
+
+Exit code 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+SCAN = ["raft_tpu", "pylibraft", "raft_dask", "tests", "bench", "ci"]
+CITE_EXEMPT = {"__init__.py"}
+# Modules with no reference analog (pure environment shims).
+CITE_EXEMPT_REL = {"raft_tpu/util/shard_map_compat.py"}
+
+
+def check_file(path: Path) -> list:
+    rel = path.relative_to(ROOT)
+    problems = []
+    text = path.read_text(encoding="utf-8")
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+
+    if text and not text.endswith("\n"):
+        problems.append(f"{rel}: missing newline at EOF")
+    for ln, line in enumerate(text.split("\n"), 1):
+        if line.startswith("\t"):
+            problems.append(f"{rel}:{ln}: tab indentation")
+        if line != line.rstrip():
+            problems.append(f"{rel}:{ln}: trailing whitespace")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and any(
+                a.name == "*" for a in node.names):
+            problems.append(f"{rel}:{node.lineno}: wildcard import")
+
+    if (rel.parts[0] == "raft_tpu" and path.name not in CITE_EXEMPT
+            and str(rel) not in CITE_EXEMPT_REL):
+        doc = ast.get_docstring(tree) or ""
+        if "ref:" not in doc.lower() and "ref pattern" not in doc.lower():
+            problems.append(
+                f"{rel}: module docstring lacks a reference citation "
+                "('Ref:'), the parity-evidence convention")
+    return problems
+
+
+def main() -> int:
+    problems = []
+    for top in SCAN:
+        for path in sorted((ROOT / top).rglob("*.py")):
+            problems += check_file(path)
+    for p in problems:
+        print(p)
+    print(f"check_style: {len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Python test gate (ref: ci/test_python.sh) — style first, then the suite.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python ci/check_style.py
+python -m pytest tests/ -x -q
