@@ -140,6 +140,9 @@ def extend(index: Index, new_vectors, new_indices, handle=None) -> Index:
 @auto_convert_output
 def search(search_params: SearchParams, index: Index, queries, k: int,
            neighbors=None, distances=None, memory_resource=None, handle=None):
+    # memory_resource is accepted for API parity with the reference binding
+    # (ivf_pq.pyx:568 takes an RMM memory resource); allocation here is
+    # managed by XLA, so the knob is a no-op.
     """Ref ivf_flat.pyx ``search`` — returns ``(distances, neighbors)``."""
     if not index.trained:
         raise ValueError("Index needs to be built before calling search.")

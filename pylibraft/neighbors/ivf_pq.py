@@ -26,7 +26,9 @@ _CODEBOOK_KINDS = {
 }
 _DTYPE_NAMES = {
     "float32": np.float32, "float16": np.float16, "bfloat16": "bfloat16",
-    "fp8": np.float16,  # fp8 LUT approximated with fp16 on TPU
+    # The reference's fp8 LUT maps to the affine uint8-quantized LUT
+    # (ivf_pq_search.cuh:70 fp_8bit analog; see raft_tpu ivf_pq.SearchParams).
+    "fp8": np.uint8,
 }
 
 
@@ -205,6 +207,9 @@ def extend(index: Index, new_vectors, new_indices, handle=None) -> Index:
 @auto_convert_output
 def search(search_params: SearchParams, index: Index, queries, k: int,
            neighbors=None, distances=None, memory_resource=None, handle=None):
+    # memory_resource is accepted for API parity with the reference binding
+    # (ivf_pq.pyx:568 takes an RMM memory resource); allocation here is
+    # managed by XLA, so the knob is a no-op.
     """Ref ivf_pq.pyx:568 — returns ``(distances, neighbors)``."""
     if not index.trained:
         raise ValueError("Index needs to be built before calling search.")

@@ -127,25 +127,23 @@ def build_index(
     if _is_l2(metric):
         nn_dist = jnp.sqrt(nn_dist)          # radii compare in true distance
 
-    # Pack groups on host (build is offline; mirrors ivf_flat's extend).
-    # One grouping pass: sort rows by (landmark, distance) so each group is
-    # a contiguous slice already in the reference's R_1nn ordering.
-    assign_h = np.asarray(assign)
-    nn_h = np.asarray(nn_dist)
-    sizes = np.bincount(assign_h, minlength=L)
-    cap = max(1, int(sizes.max()))
-    order = np.lexsort((nn_h, assign_h))
-    starts = np.concatenate([[0], np.cumsum(sizes)])
-    grp_idx = np.full((L, cap), -1, np.int32)
-    radii_np = np.zeros((L,), np.float32)
-    for l in range(L):
-        members = order[starts[l] : starts[l + 1]]
-        grp_idx[l, : members.size] = members
-        if members.size:
-            radii_np[l] = nn_h[members[-1]]  # distance-sorted: last is max
-    grp_idx_j = jnp.asarray(grp_idx)
-    safe = jnp.maximum(grp_idx_j, 0)
-    groups = X[safe]                          # (L, cap, dim)
+    # Pack groups on device (the _pack_lists scatter idiom): sort rows by
+    # (landmark, distance) so each group lands contiguous in the
+    # reference's R_1nn ordering; radii are per-group distance maxima.
+    # Only the capacity scalar reaches the host.
+    sizes = jnp.bincount(assign, length=L)
+    cap = max(1, int(jnp.max(sizes)))
+    order = jnp.lexsort((nn_dist, assign))
+    sorted_assign = assign[order].astype(jnp.int32)
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)])[:-1]
+    pos = jnp.arange(m, dtype=jnp.int32) - starts[sorted_assign].astype(
+        jnp.int32)
+    grp_idx_j = (jnp.full((L, cap), -1, jnp.int32)
+                 .at[sorted_assign, pos].set(order.astype(jnp.int32)))
+    radii = jax.ops.segment_max(nn_dist, assign, num_segments=L)
+    radii = jnp.where(sizes > 0, radii, 0.0)
+    groups = X[jnp.maximum(grp_idx_j, 0)]     # (L, cap, dim)
 
     return BallCoverIndex(
         X=X,
@@ -153,8 +151,8 @@ def build_index(
         landmarks=landmarks,
         groups=groups,
         group_indices=grp_idx_j,
-        group_sizes=jnp.asarray(sizes.astype(np.int32)),
-        radii=jnp.asarray(radii_np),
+        group_sizes=sizes.astype(jnp.int32),
+        radii=radii,
     )
 
 
@@ -211,25 +209,56 @@ def knn_query(
     dk, ik = _scan_probed(index, Q, probe_ids, k)
 
     true_dl = jnp.sqrt(dl) if _is_l2(index.metric) else dl
-    beta = jnp.sqrt(dk[:, -1]) if _is_l2(index.metric) else dk[:, -1]
 
     # Pass 2: triangle-inequality pruning over the remaining landmarks
     # (d(q,l) - radius(l) > beta ⇒ group cannot improve the result).
-    probed_mask = jnp.zeros((Q.shape[0], L), bool)
+    nq = Q.shape[0]
+    probed_mask = jnp.zeros((nq, L), bool)
     probed_mask = probed_mask.at[
-        jnp.arange(Q.shape[0])[:, None], probe_ids].set(True)
+        jnp.arange(nq)[:, None], probe_ids].set(True)
     nonempty = (index.group_sizes > 0)[None, :]
-    can_improve = (true_dl - index.radii[None, :] <= beta[:, None]) & nonempty
-    unresolved = jnp.any(can_improve & ~probed_mask, axis=1)
 
+    def _unresolved(dk_cur, mask):
+        b = jnp.sqrt(dk_cur[:, -1]) if _is_l2(index.metric) else dk_cur[:, -1]
+        can = (true_dl - index.radii[None, :] <= b[:, None]) & nonempty
+        return jnp.any(can & ~mask, axis=1)
+
+    unresolved = _unresolved(dk, probed_mask)
     n_bad = int(jnp.sum(unresolved))
+
+    # Pass 3: iterative probe widening for unresolved queries (the role of
+    # the reference's post-processing passes, spatial/knn/detail/
+    # ball_cover.cuh) — doubling the probe count re-scans only the affected
+    # queries (padded to a power of two so widening reuses compilations)
+    # instead of degenerating to a full dense scan. The dense fallback
+    # below only fires for queries still unresolved at L/2 probes, where a
+    # scan of half the groups costs about the same anyway.
+    w = n_probes
+    while n_bad and 2 * w <= max(L // 2, n_probes):
+        w = min(2 * w, L)
+        nb = 1 << (n_bad - 1).bit_length()
+        bad = jnp.nonzero(unresolved, size=nb, fill_value=0)[0]
+        real = jnp.arange(nb) < n_bad
+        _, pidb = select_k(dl[bad], w, select_min=True)
+        dkb, ikb = _scan_probed(index, Q[bad], pidb, k)
+        tgt = jnp.where(real, bad, nq)          # padding rows dropped
+        dk = dk.at[tgt].set(dkb, mode="drop")
+        ik = ik.at[tgt].set(ikb.astype(ik.dtype), mode="drop")
+        probed_mask = probed_mask.at[
+            tgt[:, None], pidb].set(True, mode="drop")
+        unresolved = _unresolved(dk, probed_mask)
+        n_bad = int(jnp.sum(unresolved))
+
     if n_bad:
-        # Dense exactness fixup for the affected queries: one matmul over X.
-        bad = jnp.nonzero(unresolved, size=n_bad)[0]
+        # Exactness fallback for the residue: dense rows for those queries.
+        nb = 1 << (n_bad - 1).bit_length()
+        bad = jnp.nonzero(unresolved, size=nb, fill_value=0)[0]
+        real = jnp.arange(nb) < n_bad
         dfull = _dist(Q[bad], index.X, index.metric)
         db_k, ib_k = select_k(dfull, k, select_min=True)
-        dk = dk.at[bad].set(db_k)
-        ik = ik.at[bad].set(ib_k.astype(ik.dtype))
+        tgt = jnp.where(real, bad, nq)
+        dk = dk.at[tgt].set(db_k, mode="drop")
+        ik = ik.at[tgt].set(ib_k.astype(ik.dtype), mode="drop")
 
     if _needs_sqrt(index.metric):
         dk = jnp.sqrt(dk)

@@ -35,8 +35,11 @@ def spmm(a: CSR, b: jax.Array) -> jax.Array:
 
 
 def add(a: CSR, b: CSR) -> CSR:
-    """C = A + B (ref: sparse/linalg/add.hpp csr_add_calc_inds/csr_add_finalize).
-    Host re-materialization: nnz of the sum is data-dependent."""
+    """C = A + B (ref: sparse/linalg/add.hpp csr_add_calc_inds /
+    csr_add_finalize). The same two-pass scheme on device: the union nnz
+    is bounded by nnz_a + nnz_b, a jitted sort/segment pass computes the
+    exact count and dedupes, and only that one scalar reaches the host to
+    size the result (see sparse/op.max_duplicates)."""
     coo_a = convert.csr_to_coo(a)
     coo_b = convert.csr_to_coo(b)
     merged = COO(

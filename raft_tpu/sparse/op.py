@@ -15,36 +15,63 @@ from raft_tpu.sparse.types import COO, CSR
 
 def coo_sort(coo: COO) -> COO:
     """Row-major (row, col) sort (ref: sparse/op/sort.hpp coo_sort)."""
-    n = max(coo.shape[1], 1)
-    key = coo.rows.astype(jnp.int64) * n + coo.cols
-    order = jnp.argsort(key)
+    order = jnp.lexsort((coo.cols, coo.rows))
     return COO(coo.rows[order], coo.cols[order], coo.vals[order], coo.shape)
+
+
+@jax.jit
+def _dedupe_pass(rows, cols, vals):
+    """Device pass of the two-pass dedupe (ref: the calc_inds/finalize
+    split of sparse/linalg/add.hpp): sort valid entries (row, col)-major,
+    mark first occurrences, segment-sum duplicate values, and scatter the
+    unique triples into an nnz-bounded buffer. Returns the buffer plus the
+    exact unique count — the only scalar the host reads."""
+    nnz = rows.shape[0]
+    invalid = rows < 0
+    order = jnp.lexsort((cols, rows, invalid))     # valid first
+    r, c, v = rows[order], cols[order], vals[order]
+    iv = invalid[order]
+    first = jnp.concatenate([
+        jnp.ones((1,), jnp.bool_),
+        (r[1:] != r[:-1]) | (c[1:] != c[:-1])]) & ~iv
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1  # unique id per entry
+    seg = jnp.where(iv, nnz, seg)                  # park invalid (dropped)
+    sums = jax.ops.segment_sum(jnp.where(iv, 0, v), jnp.minimum(seg, nnz),
+                               num_segments=nnz + 1)[:nnz]
+    out_r = jnp.full((nnz,), -1, rows.dtype).at[seg].set(r, mode="drop")
+    out_c = jnp.full((nnz,), -1, cols.dtype).at[seg].set(c, mode="drop")
+    return out_r, out_c, sums, jnp.sum(first)
+
+
+@jax.jit
+def _partition_valid(rows, cols, vals):
+    drop = (vals == 0) | (rows < 0)
+    order = jnp.argsort(drop, stable=True)         # kept entries first
+    return rows[order], cols[order], vals[order], jnp.sum(~drop)
 
 
 def remove_zeros(coo: COO) -> COO:
     """Drop explicit zeros (ref: sparse/op/filter.hpp coo_remove_zeros).
-    Host-side: nnz is a static shape, so filtering re-materializes."""
-    r = np.asarray(coo.rows)
-    c = np.asarray(coo.cols)
-    v = np.asarray(coo.vals)
-    keep = v != 0
-    return COO(jnp.asarray(r[keep]), jnp.asarray(c[keep]),
-               jnp.asarray(v[keep]), coo.shape)
+    Two-pass: a jitted partition-by-validity pass, then one scalar count
+    read sizes the exact output slice (static shapes need a host-known
+    nnz, the same reason the reference runs a count kernel first)."""
+    r, c, v, kept = _partition_valid(coo.rows, coo.cols, coo.vals)
+    kept = int(kept)
+    return COO(r[:kept], c[:kept], v[:kept], coo.shape)
 
 
 def max_duplicates(coo: COO) -> COO:
     """Deduplicate (row, col) pairs summing values (ref:
     sparse/op/reduce.hpp max_duplicates — the reference keeps a reduction
-    over duplicates; sum is its default for symmetrization)."""
-    n = max(coo.shape[1], 1)
-    key = np.asarray(coo.rows).astype(np.int64) * n + np.asarray(coo.cols)
-    uniq, inv = np.unique(key, return_inverse=True)
-    vals = np.zeros(len(uniq), dtype=np.asarray(coo.vals).dtype)
-    np.add.at(vals, inv, np.asarray(coo.vals))
-    rows = (uniq // n).astype(np.int32)
-    cols = (uniq % n).astype(np.int32)
-    return COO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals),
-               coo.shape)
+    over duplicates; sum is its default for symmetrization). Runs the
+    two-pass device scheme of sparse/linalg/add.hpp (calc_inds →
+    finalize): everything on device except the exact-nnz scalar read that
+    sizes the output."""
+    if coo.nnz == 0:
+        return coo
+    out_r, out_c, sums, n_uniq = _dedupe_pass(coo.rows, coo.cols, coo.vals)
+    k = int(n_uniq)
+    return COO(out_r[:k], out_c[:k], sums[:k], coo.shape)
 
 
 def slice_csr(csr: CSR, start: int, stop: int) -> CSR:
