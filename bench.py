@@ -193,6 +193,11 @@ def _headline():
 
 
 def main():
+    # Persistent XLA cache: round-over-round bench runs skip recompilation
+    # (the precompiled-instantiation role of the reference's libraft.so).
+    from raft_tpu.core.compilation_cache import enable_compilation_cache
+
+    enable_compilation_cache()
     try:
         _family()
     except Exception as e:  # family failures must not kill the headline

@@ -1,0 +1,39 @@
+"""Persistent XLA compilation cache.
+
+Ref: the role of the reference's precompiled ``libraft.so`` instantiation
+layer (SURVEY.md §2.13 — cpp/src template instantiations exist precisely
+so downstream users do not recompile the kernels). The TPU analog: XLA's
+persistent compilation cache makes every jitted raft_tpu program compile
+once per (shape, config) *per machine* instead of per process — a cold
+1M-row IVF build is ~95% XLA compilation, so warm-equivalent build times
+survive process restarts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from raft_tpu.core.logger import logger
+
+_DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu", "xla")
+
+
+def enable_compilation_cache(path: Optional[str] = None) -> str:
+    """Turn on JAX's persistent compilation cache at ``path`` (default
+    ``~/.cache/raft_tpu/xla``, overridable via ``RAFT_TPU_XLA_CACHE``).
+
+    Safe to call repeatedly; returns the cache directory. Opt-in (a library
+    must not silently mutate global jax config) — ``bench.py`` and the test
+    harness call it, and applications should too.
+    """
+    import jax
+
+    path = path or os.environ.get("RAFT_TPU_XLA_CACHE", _DEFAULT)
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything non-trivial: raft_tpu's many small jitted engines
+    # individually compile fast but number in the dozens per workload.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    logger.debug("persistent XLA compilation cache at %s", path)
+    return path
