@@ -90,7 +90,9 @@ class SearchParams:
 
     ``bucket_cap``: per-list query-slot capacity for "bucketed"; 0 = the
     measured sizing above. Set explicitly to skip the measurement and
-    accept drops at that capacity.
+    accept drops at that capacity. Under an outer ``jit`` the measurement
+    is impossible (abstract probe map): auto falls back to "scan", and
+    explicit "bucketed" requires an explicit bucket_cap.
     """
 
     n_probes: int = 20
@@ -407,6 +409,10 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     cap_q = bucket_cap
     cap_clamp = max(8, _BUCKET_TABLE_BYTES // max(n_lists * dim * 4, 1))
     mean_load = max(1, (n_queries * n_probes) // n_lists)
+    # Under an outer jit trace the probe map is abstract — no data-dependent
+    # capacity can exist, so auto degrades to the exact scan engine and
+    # jitted callers opt into bucketed with an explicit (static) bucket_cap.
+    tracing = isinstance(probe_ids, jax.core.Tracer)
 
     def measured_cap():
         front = int(_front_rank_contention(probe_ids, n_lists))
@@ -420,13 +426,19 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
         if (allow_bucketed and jax.default_backend() == "tpu"
                 and load >= 8 and k <= 128):
             if cap_q == 0:
-                cap_q = measured_cap()
-                engine = "bucketed" if cap_q <= cap_clamp else "scan"
+                if tracing:
+                    engine = "scan"
+                else:
+                    cap_q = measured_cap()
+                    engine = "bucketed" if cap_q <= cap_clamp else "scan"
             else:
                 engine = "bucketed"
         else:
             engine = "scan"
     elif engine == "bucketed" and cap_q == 0:
+        expects(not tracing,
+                "engine='bucketed' with bucket_cap=0 measures the probe "
+                "map and cannot run under jit; pass an explicit bucket_cap")
         cap_q = min(measured_cap(), cap_clamp)
     # Debug log at the dispatch decision, like the reference's
     # RAFT_LOG_DEBUG at perf-relevant branches (SURVEY.md §5).

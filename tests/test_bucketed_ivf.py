@@ -154,6 +154,29 @@ def test_bucketed_measured_cap_skewed_queries(rng):
     assert agree > 0.999, f"measured-cap bucketed != scan on skew: {agree}"
 
 
+def test_search_traceable_under_jit(rng):
+    """search(engine='auto') must stay jittable: under a trace no
+    data-dependent capacity can be measured, so auto degrades to the exact
+    scan engine; explicit bucketed with cap=0 raises a clear error."""
+    import jax
+
+    from raft_tpu.core.error import RaftError
+
+    db = rng.normal(size=(2000, 16)).astype(np.float32)
+    Q = rng.normal(size=(50, 16)).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4),
+                         db)
+    sp = ivf_flat.SearchParams(n_probes=8)
+    d_jit, i_jit = jax.jit(lambda q: ivf_flat.search(sp, idx, q, 5))(Q)
+    d_e, i_e = ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, engine="scan"), idx, Q, 5)
+    np.testing.assert_array_equal(np.asarray(i_jit), np.asarray(i_e))
+    with pytest.raises(RaftError, match="bucket_cap"):
+        jax.jit(lambda q: ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=8, engine="bucketed"),
+            idx, q, 5))(Q)
+
+
 def test_bucketed_auto_cap_recall(rng):
     """Tight auto bucket_cap loses at most the documented overflow — recall
     stays above the reference's n_probes/n_lists lower bound
