@@ -20,7 +20,7 @@ from pylibraft.distance.pairwise_distance import DISTANCE_TYPES
 @auto_convert_output
 def knn(dataset, queries, k=None, indices=None, distances=None,
         metric="sqeuclidean", metric_arg=2.0, global_id_offset=0,
-        handle=None):
+        idx_dtype="int32", handle=None):
     """Exact nearest neighbors; returns ``(distances, indices)`` like the
     reference (brute_force.pyx:179).
 
@@ -45,10 +45,11 @@ def knn(dataset, queries, k=None, indices=None, distances=None,
             raise ValueError("k must be given or deducible from indices/distances")
 
     metric_dt = DISTANCE_TYPES[metric] if isinstance(metric, str) else metric
+    # idx_dtype="int64" matches the reference's int64_t binding
+    # (brute_force_knn_int64_t_float.cu); requires jax_enable_x64.
     d, i = _bf.knn(ds.array, q.array, int(k), metric=metric_dt,
-                   metric_arg=metric_arg)
-    if global_id_offset:
-        i = i + int(global_id_offset)
+                   metric_arg=metric_arg, global_id_offset=global_id_offset,
+                   idx_dtype=idx_dtype)
 
     if distances is not None and isinstance(distances, np.ndarray):
         np.copyto(distances, np.asarray(d))
