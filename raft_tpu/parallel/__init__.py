@@ -3,7 +3,11 @@
 with comms collectives, SURVEY.md §2.12 item 4)."""
 
 from raft_tpu.parallel.knn import sharded_knn
-from raft_tpu.parallel.kmeans import sharded_kmeans_fit, sharded_kmeans_step
+from raft_tpu.parallel.kmeans import (
+    sharded_kmeans_balanced_fit,
+    sharded_kmeans_fit,
+    sharded_kmeans_step,
+)
 from raft_tpu.parallel.ivf import (
     ShardedIvfFlat,
     ShardedIvfPq,
@@ -15,6 +19,7 @@ from raft_tpu.parallel.ivf import (
 
 __all__ = [
     "sharded_knn", "sharded_kmeans_fit", "sharded_kmeans_step",
+    "sharded_kmeans_balanced_fit",
     "ShardedIvfFlat", "ShardedIvfPq",
     "sharded_ivf_flat_build", "sharded_ivf_flat_search",
     "sharded_ivf_pq_build", "sharded_ivf_pq_search",

@@ -100,19 +100,29 @@ def _shard_pack(mesh: Mesh, axis: str, rows, labels_h, ids, n_lists: int):
 
 def sharded_ivf_flat_build(
     mesh: Mesh, params: "_flat.IndexParams", dataset, axis: str = "data",
-    centers: Optional[jax.Array] = None,
+    centers: Optional[jax.Array] = None, train_distributed: bool = False,
 ) -> ShardedIvfFlat:
     """Build with rows sharded over ``mesh[axis]`` (ref: the MNMG
     shard-then-merge recipe, using_comms.rst). ``centers`` injects a
-    pre-trained coarse model (otherwise trained like ivf_flat.build).
-    Row count must divide the axis size (pad upstream; static shapes)."""
+    pre-trained coarse model (otherwise trained like ivf_flat.build);
+    ``train_distributed`` trains them with the sharded balancing EM
+    instead (for datasets beyond one device's HBM — quality of the flat
+    distributed EM trails the hierarchical single-device trainer
+    slightly). Row count must divide the axis size (pad upstream)."""
     X = _flat._as_float(_flat.as_array(dataset))
     n, dim = X.shape
     n_dev = mesh.shape[axis]
     expects(n % n_dev == 0, "rows must divide the mesh axis (pad first)")
 
     if centers is None:
-        centers = _flat._train_centers(params, X)
+        if train_distributed:
+            from raft_tpu.parallel.kmeans import sharded_kmeans_balanced_fit
+
+            centers = sharded_kmeans_balanced_fit(
+                mesh, X, params.n_lists, n_iters=params.kmeans_n_iters,
+                axis=axis)
+        else:
+            centers = _flat._train_centers(params, X)
 
     labels = kmeans_balanced.predict(
         KMeansBalancedParams(metric=params.metric), centers, X)

@@ -87,3 +87,85 @@ def sharded_kmeans_fit(
         centroids, inertia = _sharded_em_step_jit(X, centroids, mesh=mesh,
                                                   axis=axis, k=k)
     return centroids, inertia
+
+
+# ---------------------------------------------------------------------------
+# Distributed balanced k-means (the trainer behind IVF indexes) — the
+# sharded analog of cluster/kmeans_balanced._balanced_em.
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "n_iters", "n_clusters"))
+def _sharded_balanced_em_jit(X, centroids0, *, mesh, axis, n_iters,
+                             n_clusters):
+    """Balancing EM entirely inside one jitted shard_map: assignment and
+    sufficient statistics are local + psum (ref: balancing_em_iters,
+    detail/kmeans_balanced.cuh:616, distributed per the kmeans-MG recipe);
+    the adjust_centers re-seed picks GLOBAL top-cost samples by
+    all-gathering each device's local top-n_clusters candidate rows."""
+    n_dev = mesh.shape[axis]
+
+    def body(X_local, c0):
+        n_local = X_local.shape[0]
+        threshold = jnp.maximum(
+            jnp.asarray(1.0, X_local.dtype),
+            jnp.asarray(0.25 * n_local * n_dev / n_clusters, X_local.dtype))
+
+        def em(_, centroids):
+            dists, labels = fused_l2_nn_min_reduce(X_local, centroids)
+            sums = lax.psum(
+                jax.ops.segment_sum(X_local, labels,
+                                    num_segments=n_clusters), axis)
+            counts = lax.psum(
+                jax.ops.segment_sum(
+                    jnp.ones((n_local,), X_local.dtype), labels,
+                    num_segments=n_clusters), axis)
+            new = sums / jnp.maximum(counts, 1.0)[:, None]
+            new = jnp.where((counts > 0)[:, None], new, centroids)
+
+            # adjust_centers: global top-cost candidate rows = union of
+            # per-device top-n_clusters, re-ranked after an all_gather
+            # (k·n_dev rows of traffic, never the shards).
+            kk = min(n_clusters, n_local)
+            top_d, top_i = lax.top_k(dists, kk)
+            cand_rows = X_local[top_i]                    # (kk, d)
+            all_d = lax.all_gather(top_d, axis, axis=0, tiled=True)
+            all_rows = lax.all_gather(cand_rows, axis, axis=0, tiled=True)
+            _, pos = lax.top_k(all_d, n_clusters)
+            seeds = all_rows[pos]                         # (k, d) global
+
+            order = jnp.argsort(counts)
+            rank = jnp.argsort(order)
+            n_small = jnp.sum(counts < threshold)
+            reseed = rank < n_small
+            return jnp.where(reseed[:, None], seeds[rank], new)
+
+        return lax.fori_loop(0, n_iters, em, c0)
+
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(axis, None), P(None, None)),
+                   out_specs=P(None, None))
+    return fn(X, centroids0)
+
+
+def sharded_kmeans_balanced_fit(
+    mesh: Mesh, X, n_clusters: int, n_iters: int = 20, axis: str = "data",
+) -> jax.Array:
+    """Distributed balanced k-means over row-sharded data (ref:
+    kmeans_balanced::fit distributed per the MNMG recipe,
+    docs/source/using_comms.rst) — the center trainer for sharded IVF
+    builds at dataset sizes beyond one device's HBM.
+
+    Flat (non-hierarchical) balancing EM: initial centroids are evenly
+    strided global rows, each iteration is local-assign + psum'd
+    statistics + global top-cost re-seeding. Returns replicated
+    (n_clusters, dim) centroids.
+    """
+    X = jnp.asarray(X)
+    n = X.shape[0]
+    expects(n % mesh.shape[axis] == 0,
+            "rows must divide the mesh axis (pad first)")
+    expects(n >= n_clusters, "need at least n_clusters rows")
+    centroids0 = X[:: max(n // n_clusters, 1)][:n_clusters]
+    return _sharded_balanced_em_jit(X, centroids0, mesh=mesh, axis=axis,
+                                    n_iters=n_iters, n_clusters=n_clusters)
