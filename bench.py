@@ -120,6 +120,22 @@ def _family():
     _emit("kmeans_balanced_fit_100k_s", s, "s",
           _R1["kmeans_balanced_fit_100k_s"] / s)
 
+    # sparse pairwise L2, 2048 x 2048 at 50k dims, ~0.1% dense (block-staged
+    # engine; round 1 densified and could not run this shape) — wall seconds,
+    # new this round (vs_baseline = 1.0 by definition)
+    from raft_tpu.sparse import distance as sparse_distance
+    from raft_tpu.sparse.types import CSR
+
+    d_sp, nnz_row, rows = 50_000, 50, 2048
+    cols = rng.integers(0, d_sp, size=rows * nnz_row).astype(np.int32)
+    valsv = rng.normal(size=rows * nnz_row).astype(np.float32)
+    indptr = np.arange(0, rows * nnz_row + 1, nnz_row, dtype=np.int32)
+    ca = CSR(jnp.asarray(indptr), jnp.asarray(cols), jnp.asarray(valsv),
+             (rows, d_sp))
+    s = wall_time(lambda: sparse_distance.pairwise_distance(
+        ca, ca, metric="euclidean"))
+    _emit("sparse_l2_2048x50kd_s", s, "s", 1.0)
+
 
 def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
     rng = np.random.default_rng(seed)
