@@ -104,16 +104,23 @@ class Comms:
 
     def sync_stream(self, *arrays) -> StatusT:
         """Ref: comms_t::sync_stream (status-returning async-error probe,
-        core/comms.hpp:290). Cooperative cancellation surfaces as ABORT —
-        the role of the reference's ncclCommAbort-triggered status — while
-        XLA/collective failures surface as ERROR."""
+        core/comms.hpp:290). Cooperative cancellation (interruptible.cancel)
+        surfaces as ABORT — the role of the reference's
+        ncclCommAbort-triggered status — while XLA/collective failures
+        surface as ERROR. A raw KeyboardInterrupt (ctrl-C outside the
+        cooperative chain) propagates: swallowing it would let callers that
+        ignore the returned status spin forever.
+        """
+        from raft_tpu.core import interruptible
         from raft_tpu.core.interruptible import InterruptedException
 
         try:
-            for a in arrays:
-                jax.block_until_ready(a)
+            # interruptible.synchronize polls the thread's cancellation
+            # token while waiting, so cancel()/cancel_thread() can actually
+            # surface here (a raw block_until_ready never observes it).
+            interruptible.synchronize(*arrays)
             return StatusT.SUCCESS
-        except (InterruptedException, KeyboardInterrupt):
+        except InterruptedException:
             return StatusT.ABORT
         except Exception:  # XLA surfaces collective failures as exceptions
             return StatusT.ERROR

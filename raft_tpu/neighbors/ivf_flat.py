@@ -604,6 +604,10 @@ def load(filename: str) -> Index:
         version = int(z["version"])
         expects(version == SERIALIZATION_VERSION,
                 f"serialization version mismatch: {version}")
+        # Guard the deserialize path the same way build() guards its
+        # idx_dtype knob: int64 ids without x64 enabled would otherwise be
+        # silently truncated to int32 by jnp.asarray.
+        validate_idx_dtype(z["indices"].dtype)
         return Index(
             metric=DistanceType(int(z["metric"])),
             centers=jnp.asarray(z["centers"]),

@@ -826,7 +826,12 @@ def load(filename: str) -> Index:
     with np.load(filename) as z:
         version = int(z["version"])
         expects(version == SERIALIZATION_VERSION,
-                f"serialization version mismatch: {version}")
+                f"serialization version mismatch: {version}"
+                + (" (v3 unpacked-codes indexes predate the bit-packed "
+                   "layout; rebuild or re-save from a v3-era checkout)"
+                   if version == 3 else ""))
+        # int64 ids require x64 — otherwise jnp.asarray silently truncates.
+        validate_idx_dtype(z["indices"].dtype)
         return Index(
             metric=DistanceType(int(z["metric"])),
             codebook_kind=CodebookGen(int(z["codebook_kind"])),

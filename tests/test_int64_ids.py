@@ -92,6 +92,30 @@ def test_int64_without_x64_fails_fast():
         brute_force.knn(db, db, 2, idx_dtype=jnp.int64)
 
 
+def test_load_int64_without_x64_fails_fast(tmp_path):
+    """load() must not silently truncate int64 ids saved by an x64 process
+    (the deserialize path previously skipped the validate_idx_dtype guard
+    that build() applies)."""
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+    rng = np.random.default_rng(0)
+    db = rng.normal(size=(256, 8)).astype(np.float32)
+    for mod, params in ((ivf_flat, ivf_flat.IndexParams(n_lists=4,
+                                                        kmeans_n_iters=2)),
+                        (ivf_pq, ivf_pq.IndexParams(n_lists=4, pq_dim=4,
+                                                    kmeans_n_iters=2))):
+        idx = mod.build(params, db)
+        f = str(tmp_path / f"{mod.__name__}.npz")
+        mod.save(f, idx)
+        # Rewrite the indices payload as int64, as an x64 save would emit.
+        z = dict(np.load(f))
+        z["indices"] = np.asarray(z["indices"], dtype=np.int64)
+        np.savez(f, **z)
+        with pytest.raises(RaftError, match="x64"):
+            mod.load(f)
+
+
 def test_idx_dtype_rejects_non_integer():
     from raft_tpu.core.error import RaftError
     from raft_tpu.neighbors import brute_force
