@@ -92,7 +92,10 @@ class SearchParams:
     measured sizing above. Set explicitly to skip the measurement and
     accept drops at that capacity. Under an outer ``jit`` the measurement
     is impossible (abstract probe map): auto falls back to "scan", and
-    explicit "bucketed" requires an explicit bucket_cap.
+    explicit "bucketed" requires an explicit bucket_cap. The measured
+    capacity is memoized on the index per query-batch shape, so a
+    steady-state query loop pays the measurement readback once;
+    ``extend`` invalidates the memo.
     """
 
     n_probes: int = 20
@@ -235,19 +238,94 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     return index
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(6,))
+def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
+                    adaptive: bool = False, centers=None):
+    """O(n_new) append into capacity-padded lists.
+
+    Ref: the per-list append of ivf_flat::extend
+    (detail/ivf_flat_build.cuh:159) — new rows land at each list's current
+    fill offset. The padded-tensor analog: sort the *new* rows by list,
+    in-list position = ``list_sizes[label] + rank``, then one scatter.
+    ``store``/``ids`` are donated so XLA aliases the output onto the
+    existing buffers — no full-index gather or copy appears anywhere in
+    the program. Shared by ivf_flat (payload = vectors) and ivf_pq
+    (payload = packed code rows).
+    """
+    n_lists = store.shape[0]
+    n_new = new_rows.shape[0]
+    labels = labels.astype(jnp.int32)
+    counts = jnp.bincount(labels, length=n_lists)
+    order = jnp.argsort(labels, stable=True)
+    sl = labels[order]
+    offsets = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n_new, dtype=jnp.int32) - offsets[sl].astype(jnp.int32)
+    pos = list_sizes[sl] + rank
+    store = store.at[sl, pos].set(new_rows[order].astype(store.dtype))
+    ids = ids.at[sl, pos].set(new_ids[order])
+    new_sizes = list_sizes + counts.astype(jnp.int32)
+    if adaptive:
+        # Running-mean drift (ivf_flat_types.hpp:53-58): with the center
+        # equal to the mean of its members before the append, the
+        # size-weighted update keeps it the mean after — no pass over the
+        # existing rows needed.
+        sums = jax.ops.segment_sum(new_rows.astype(centers.dtype), labels,
+                                   num_segments=n_lists)
+        tot = jnp.maximum(new_sizes.astype(centers.dtype), 1.0)
+        upd = (centers * list_sizes.astype(centers.dtype)[:, None] + sums) \
+            / tot[:, None]
+        centers = jnp.where((counts > 0)[:, None], upd, centers)
+    return store, ids, new_sizes, centers
+
+
+def _grown_cap(list_sizes, counts, cap: int, conservative: bool):
+    """Post-append capacity: unchanged when everything fits, else the
+    next power of two (amortized doubling, ivf_flat_types.hpp:65-73) or
+    the exact requirement under conservative allocation. One scalar
+    device→host read."""
+    need = int(jnp.max(list_sizes + counts))
+    if need <= cap:
+        return cap
+    return max(need, 1) if conservative else next_pow2(need)
+
+
+def _append_in_place(store, ids, list_sizes, payload, new_ids, labels,
+                     conservative: bool, adaptive: bool = False,
+                     centers=None):
+    """Grow-if-needed + donated scatter-append, shared by ivf_flat (payload
+    = vectors) and ivf_pq (payload = packed code rows). Returns
+    ``(store, ids, sizes, centers)``."""
+    counts = jnp.bincount(labels.astype(jnp.int32), length=store.shape[0])
+    cap = store.shape[1]
+    new_cap = _grown_cap(list_sizes, counts, cap, conservative)
+    if new_cap > cap:
+        # Amortized growth: pad in place — existing rows keep their slots.
+        store = jnp.pad(store, ((0, 0), (0, new_cap - cap), (0, 0)))
+        ids = jnp.pad(ids, ((0, 0), (0, new_cap - cap)), constant_values=-1)
+    return _scatter_append(store, ids, list_sizes,
+                           payload.astype(store.dtype), new_ids, labels,
+                           adaptive, centers)
+
+
 @traced
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
-    """Append vectors to the index (re-pack with capacity growth).
+    """Append vectors to the index, in place, at O(n_new) amortized cost.
 
-    Ref: ivf_flat::extend (detail/ivf_flat_build.cuh:159). The reference
-    grows each list's allocation amortized; the padded-tensor analog is a
-    re-pack at the doubled capacity when the current one overflows. When
-    ``adaptive_centers`` is set, centers drift to the running mean of their
-    members (ivf_flat_types.hpp:53-58 / build:~250).
+    Ref: ivf_flat::extend (detail/ivf_flat_build.cuh:159; list growth
+    policy ivf_flat_types.hpp:65-73). New rows scatter into each list's
+    free slots (the storage buffers are donated to the scatter, so no
+    copy of the existing rows is made); only when a list overflows its
+    capacity does storage grow — by padding to the doubled capacity,
+    which moves no existing row. The passed ``index`` is mutated and
+    returned; arrays previously read off it (``index.data`` etc.) must
+    be re-read after the call. When ``adaptive_centers`` is set, centers
+    drift to the running mean of their members (ivf_flat_types.hpp:53-58).
     """
     X = as_array(new_vectors)
     expects(X.ndim == 2 and X.shape[1] == index.dim, "dim mismatch")
     n_new = X.shape[0]
+    if n_new == 0:
+        return index
     if new_indices is None:
         base = index.size
         new_indices = jnp.arange(base, base + n_new,
@@ -259,45 +337,37 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         KMeansBalancedParams(metric=index.metric), index.centers, _as_float(X)
     )
 
-    # Merge with existing valid rows, then re-pack (amortized growth: round
-    # capacity to the next power of two unless conservative).
     old_n = index.size
-    if old_n:
-        cap = index.data.shape[1]
-        slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
-        valid = slot < index.list_sizes[:, None]
-        old_rows = index.data.reshape(-1, index.dim)[valid.reshape(-1)]
-        old_ids = index.indices.reshape(-1)[valid.reshape(-1)]
-        old_labels = jnp.repeat(
-            jnp.arange(index.n_lists, dtype=jnp.int32), index.list_sizes,
-            total_repeat_length=old_n,
-        )
-        all_rows = jnp.concatenate([old_rows, X.astype(index.data.dtype)])
-        all_ids = jnp.concatenate([old_ids, new_indices])
-        all_labels = jnp.concatenate([old_labels, labels])
-    else:
-        all_rows = X
-        all_ids = new_indices
-        all_labels = labels
+    if not old_n:
+        # Bulk path (build-time fill of an empty index): one pack.
+        min_cap = 0
+        if not index.conservative_memory_allocation:
+            counts = jnp.bincount(labels, length=index.n_lists)
+            min_cap = next_pow2(int(jnp.max(counts)))
+        data, ids, sizes = _pack_lists(X.astype(index.data.dtype), labels,
+                                       new_indices, index.n_lists, min_cap)
+        centers = index.centers
+        if index.adaptive_centers:
+            sums = jax.ops.segment_sum(_as_float(X), labels,
+                                       num_segments=index.n_lists)
+            cnt = jnp.maximum(sizes.astype(centers.dtype), 1.0)
+            centers = jnp.where((sizes > 0)[:, None],
+                                sums / cnt[:, None], centers)
+        index.data, index.indices, index.list_sizes = data, ids, sizes
+        index.centers = centers
+        index.__dict__.pop("_auto_cap_cache", None)
+        return index
 
-    min_cap = 0
-    if not index.conservative_memory_allocation:
-        counts = jnp.bincount(all_labels, length=index.n_lists)
-        min_cap = next_pow2(int(jnp.max(counts)))
-    data, ids, sizes = _pack_lists(all_rows, all_labels, all_ids, index.n_lists, min_cap)
-
-    centers = index.centers
+    data, ids, sizes, centers = _append_in_place(
+        index.data, index.indices, index.list_sizes, X, new_indices,
+        labels, index.conservative_memory_allocation,
+        index.adaptive_centers,
+        index.centers if index.adaptive_centers else None)
+    index.data, index.indices, index.list_sizes = data, ids, sizes
     if index.adaptive_centers:
-        sums = jax.ops.segment_sum(_as_float(all_rows), all_labels,
-                                   num_segments=index.n_lists)
-        cnt = jnp.maximum(sizes.astype(centers.dtype), 1.0)
-        centers = jnp.where((sizes > 0)[:, None], sums / cnt[:, None], centers)
-
-    return Index(
-        metric=index.metric, centers=centers, data=data, indices=ids,
-        list_sizes=sizes, adaptive_centers=index.adaptive_centers,
-        conservative_memory_allocation=index.conservative_memory_allocation,
-    )
+        index.centers = centers
+    index.__dict__.pop("_auto_cap_cache", None)  # occupancy changed
+    return index
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6, 7))
@@ -376,6 +446,13 @@ def _chunked_over_queries(fn, Q, probe_ids, per_q_bytes: int,
 _BUCKET_TABLE_BYTES = 512 * 1024 * 1024
 
 
+def _auto_cap_cache(index) -> dict:
+    """Per-index memo for the auto-engine's measured bucket capacity
+    (plain instance attribute — Index is not a pytree). Cleared by
+    extend(), which changes list occupancy."""
+    return index.__dict__.setdefault("_auto_cap_cache", {})
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def _front_rank_contention(probe_ids, n_lists: int):
     """Max per-list count of (query, probe) pairs whose centroid rank is in
@@ -389,7 +466,7 @@ def _front_rank_contention(probe_ids, n_lists: int):
 
 def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
                  k: int, bucket_cap: int, dim: int, probe_ids,
-                 allow_bucketed: bool = True):
+                 allow_bucketed: bool = True, cap_cache=None):
     """Resolve SearchParams.engine/"auto" and the bucket capacity — shared
     by ivf_flat.search and ivf_pq.search. Bucketed wins when the mean probe
     load per list fills MXU tiles; tiny loads leave the batched kernel
@@ -403,6 +480,20 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     auto falls back to the exact scan engine instead of truncating hot
     lists. An explicit ``bucket_cap`` skips the measurement and accepts
     the documented drop behavior at that capacity.
+
+    ``cap_cache`` (a dict owned by the Index) memoizes the measured
+    capacity per (n_queries, n_probes) so a steady-state query loop pays
+    the ~RTT-bound scalar readback once, not per call — the role of the
+    reference's per-index ``get_max_batch_size`` heuristic
+    (detail/ivf_pq_search.cuh:1517). The memo assumes batches drawn from
+    a stationary query distribution: the capacity is measured on the
+    first batch of a shape (rounded up to a power of two, which absorbs
+    ~2× contention drift), so a later same-shape batch that concentrates
+    much harder on one centroid can overflow it and drop lower-ranked
+    probes of the hot list. Callers whose distribution shifts should pass
+    an explicit ``bucket_cap`` or drop the memo (``del index.__dict__
+    ['_auto_cap_cache']``); extend() invalidates it when occupancy
+    changes.
     """
     expects(engine in ("auto", "scan", "bucketed"),
             f"unknown engine {engine!r} (auto|scan|bucketed)")
@@ -415,11 +506,17 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     tracing = isinstance(probe_ids, jax.core.Tracer)
 
     def measured_cap():
+        key = (n_queries, n_probes)
+        if cap_cache is not None and key in cap_cache:
+            return cap_cache[key]
         front = int(_front_rank_contention(probe_ids, n_lists))
         # Next power of two: batches with slightly different contention
         # land on the same compiled bucket shapes.
         cap = 1 << (max(front, 4 * mean_load, 8) - 1).bit_length()
-        return min(n_queries, cap)
+        cap = min(n_queries, cap)
+        if cap_cache is not None:
+            cap_cache[key] = cap
+        return cap
 
     if engine == "auto":
         load = n_queries * n_probes / n_lists
@@ -553,7 +650,8 @@ def search(
 
     engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
                                  index.n_lists, k, params.bucket_cap,
-                                 index.dim, probe_ids)
+                                 index.dim, probe_ids,
+                                 cap_cache=_auto_cap_cache(index))
     if engine == "bucketed":
         return _bucketed_probe_scan(
             Q, dataf, index.indices, index.list_sizes, probe_ids,

@@ -50,6 +50,8 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors.ivf_flat import (
+    _append_in_place,
+    _auto_cap_cache,
     _bucketed_probe_scan,
     _chunked_over_queries,
     _pack_lists,
@@ -525,14 +527,31 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     return index
 
 
+def _invalidate_caches(index: Index) -> None:
+    """Drop derived per-index caches after a storage mutation: the lazy
+    bf16 reconstruction (stale codes/capacity would silently corrupt
+    bucketed search) and the measured bucket-capacity memo."""
+    index._recon = None
+    index.__dict__.pop("_auto_cap_cache", None)
+
+
 @traced
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
-    """Encode + append rows (ref: ivf_pq::extend, ivf_pq_build.cuh:873 →
-    process_and_fill_codes:724). Existing codes are kept; storage re-packs
-    at doubled capacity (amortized growth)."""
+    """Encode + append rows in place at O(n_new) amortized cost.
+
+    Ref: ivf_pq::extend (ivf_pq_build.cuh:873 →
+    process_and_fill_codes:724; list growth ivf_flat_types.hpp:65-73).
+    Only the *new* rows are encoded; their packed code rows scatter into
+    each list's free slots via the shared donating scatter-append, so the
+    existing codes are never gathered or copied. Storage grows by padding
+    to the doubled capacity on overflow. The passed ``index`` is mutated
+    and returned; arrays previously read off it must be re-read after the
+    call."""
     X = _as_float(new_vectors)
     expects(X.ndim == 2 and X.shape[1] == index.dim, "dim mismatch")
     n_new = X.shape[0]
+    if n_new == 0:
+        return index
     if new_indices is None:
         base = index.size
         new_indices = jnp.arange(base, base + n_new,
@@ -550,39 +569,25 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         codes = _encode_per_cluster(res, labels, index.pq_centers)
     codes = pack_codes(codes, index.pq_bits)
 
-    # Merge with existing valid rows (codes are bit-packed byte rows).
     old_n = index.size
-    if old_n:
-        cap = index.pq_codes.shape[1]
-        slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
-        valid = (slot < index.list_sizes[:, None]).reshape(-1)
-        old_codes = index.pq_codes.reshape(
-            -1, index.pq_codes.shape[2])[valid]
-        old_ids = index.indices.reshape(-1)[valid]
-        old_labels = jnp.repeat(
-            jnp.arange(index.n_lists, dtype=jnp.int32), index.list_sizes,
-            total_repeat_length=old_n)
-        all_codes = jnp.concatenate([old_codes, codes])
-        all_ids = jnp.concatenate([old_ids, new_indices])
-        all_labels = jnp.concatenate([old_labels, labels])
-    else:
-        all_codes, all_ids, all_labels = codes, new_indices, labels
+    if not old_n:
+        min_cap = 0
+        if not index.conservative_memory_allocation:
+            counts = jnp.bincount(labels, length=index.n_lists)
+            min_cap = next_pow2(int(jnp.max(counts)))
+        packed, ids, sizes = _pack_lists(codes, labels, new_indices,
+                                         index.n_lists, min_cap)
+        index.pq_codes = packed.astype(jnp.uint8)
+        index.indices, index.list_sizes = ids, sizes
+        _invalidate_caches(index)
+        return index
 
-    min_cap = 0
-    if not index.conservative_memory_allocation:
-        counts = jnp.bincount(all_labels, length=index.n_lists)
-        min_cap = next_pow2(int(jnp.max(counts)))
-    packed, ids, sizes = _pack_lists(all_codes, all_labels, all_ids,
-                                     index.n_lists, min_cap)
-
-    return Index(
-        metric=index.metric, codebook_kind=index.codebook_kind,
-        centers=index.centers, rotation_matrix=index.rotation_matrix,
-        pq_centers=index.pq_centers, pq_codes=packed.astype(jnp.uint8),
-        indices=ids, list_sizes=sizes, pq_bits=index.pq_bits,
-        pq_dim=index.pq_dim,
-        conservative_memory_allocation=index.conservative_memory_allocation,
-    )
+    store, ids, sizes, _ = _append_in_place(
+        index.pq_codes, index.indices, index.list_sizes, codes,
+        new_indices, labels, index.conservative_memory_allocation)
+    index.pq_codes, index.indices, index.list_sizes = store, ids, sizes
+    _invalidate_caches(index)
+    return index
 
 
 def _lut_scores(lut, codes, scale=None):
@@ -755,7 +760,8 @@ def search(
     engine, cap_q = _pick_engine(
         params.engine, Q.shape[0], n_probes, index.n_lists, k,
         params.bucket_cap, index.rot_dim, probe_ids,
-        allow_bucketed=default_dtypes and recon_bytes <= _RECON_AUTO_BYTES)
+        allow_bucketed=default_dtypes and recon_bytes <= _RECON_AUTO_BYTES,
+        cap_cache=_auto_cap_cache(index))
     if engine == "bucketed":
         best_d, best_i = _bucketed_probe_scan(
             rotq, index.reconstructed(),

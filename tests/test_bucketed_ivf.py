@@ -193,3 +193,37 @@ def test_bucketed_auto_cap_recall(rng):
         len(np.intersect1d(np.asarray(bi)[r], np.asarray(ei)[r])) / k
         for r in range(qn)])
     assert rec >= 8 / 16, f"recall {rec} below n_probes/n_lists bound"
+
+
+def test_measured_cap_cached_per_index(rng, monkeypatch):
+    """The auto/measured capacity readback runs once per (index, query
+    shape) and is memoized on the index (the per-index batch-size
+    heuristic role of detail/ivf_pq_search.cuh:1517); extend() changes
+    occupancy and invalidates it."""
+    from raft_tpu.neighbors import ivf_flat as impl
+
+    db = rng.normal(size=(3000, 16)).astype(np.float32)
+    Q = rng.normal(size=(200, 16)).astype(np.float32)
+    idx = impl.build(impl.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+
+    calls = []
+    real = impl._front_rank_contention
+
+    def counting(probe_ids, n_lists):
+        calls.append(1)
+        return real(probe_ids, n_lists)
+
+    monkeypatch.setattr(impl, "_front_rank_contention", counting)
+    sp = impl.SearchParams(n_probes=8, engine="bucketed")
+    d1, i1 = impl.search(sp, idx, Q, 5)
+    assert len(calls) == 1
+    d2, i2 = impl.search(sp, idx, Q, 5)
+    assert len(calls) == 1  # cache hit: no second device readback
+    np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+    # different batch shape -> separate measurement
+    impl.search(sp, idx, Q[:64], 5)
+    assert len(calls) == 2
+    # extend invalidates (occupancy changed)
+    impl.extend(idx, db[:8], np.arange(8, dtype=np.int32))
+    impl.search(sp, idx, Q, 5)
+    assert len(calls) == 3

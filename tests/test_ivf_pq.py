@@ -149,6 +149,44 @@ class TestIvfPq:
         d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 10)
         assert _recall(np.asarray(i), truth) > 0.7
 
+    def test_extend_in_place(self, dataset):
+        """Fitting extend donates + aliases the packed-code storage —
+        no full-index repack (ref: process_and_fill_codes appends at the
+        list fill offset, ivf_pq_build.cuh:724)."""
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, db)
+        if index.pq_codes.shape[1] == int(np.max(np.asarray(index.list_sizes))):
+            index = ivf_pq.extend(index, db[:1])  # force headroom
+        cap0 = index.pq_codes.shape[1]
+        free = cap0 - int(np.max(np.asarray(index.list_sizes)))
+        n_extra = min(16, free)
+        ptr0 = index.pq_codes.unsafe_buffer_pointer()
+        out = ivf_pq.extend(index, db[:n_extra],
+                            np.arange(n_extra, dtype=np.int32))
+        assert out is index
+        assert index.pq_codes.shape[1] == cap0
+        assert index.pq_codes.unsafe_buffer_pointer() == ptr0
+        d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=16), index, q, 10)
+        assert _recall(np.asarray(i), truth) > 0.7
+
+    def test_extend_invalidates_recon_cache(self, dataset):
+        """Bucketed search populates the lazy bf16 reconstruction cache;
+        an in-place extend must drop it, or post-extend bucketed searches
+        score against stale (or wrongly-shaped) reconstructions."""
+        db, q, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
+        index = ivf_pq.build(params, db[:3000])
+        sp = ivf_pq.SearchParams(n_probes=16, engine="bucketed")
+        ivf_pq.search(sp, index, q, 10)          # populates _recon
+        assert index._recon is not None
+        index = ivf_pq.extend(index, db[3000:],
+                              np.arange(3000, len(db), dtype=np.int32))
+        assert index._recon is None              # invalidated
+        d, i = ivf_pq.search(sp, index, q, 10)
+        # the new rows must be findable through the bucketed engine
+        assert int(np.asarray(i).max()) >= 3000
+
     def test_save_load_roundtrip(self, dataset, tmp_path):
         db, q, _ = dataset
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
