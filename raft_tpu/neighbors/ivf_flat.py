@@ -238,20 +238,10 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     return index
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(6,))
-def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
-                    adaptive: bool = False, centers=None):
-    """O(n_new) append into capacity-padded lists.
-
-    Ref: the per-list append of ivf_flat::extend
-    (detail/ivf_flat_build.cuh:159) — new rows land at each list's current
-    fill offset. The padded-tensor analog: sort the *new* rows by list,
+def _scatter_append_core(store, ids, list_sizes, new_rows, new_ids, labels):
+    """Traceable core of the O(n_new) append: sort the *new* rows by list,
     in-list position = ``list_sizes[label] + rank``, then one scatter.
-    ``store``/``ids`` are donated so XLA aliases the output onto the
-    existing buffers — no full-index gather or copy appears anywhere in
-    the program. Shared by ivf_flat (payload = vectors) and ivf_pq
-    (payload = packed code rows).
-    """
+    Also used vmapped over the shard axis by parallel/ivf.py."""
     n_lists = store.shape[0]
     n_new = new_rows.shape[0]
     labels = labels.astype(jnp.int32)
@@ -263,14 +253,31 @@ def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
     pos = list_sizes[sl] + rank
     store = store.at[sl, pos].set(new_rows[order].astype(store.dtype))
     ids = ids.at[sl, pos].set(new_ids[order])
-    new_sizes = list_sizes + counts.astype(jnp.int32)
+    return store, ids, list_sizes + counts.astype(jnp.int32), counts
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(6,))
+def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
+                    adaptive: bool = False, centers=None):
+    """O(n_new) append into capacity-padded lists.
+
+    Ref: the per-list append of ivf_flat::extend
+    (detail/ivf_flat_build.cuh:159) — new rows land at each list's current
+    fill offset. ``store``/``ids`` are donated so XLA aliases the output
+    onto the existing buffers — no full-index gather or copy appears
+    anywhere in the program. Shared by ivf_flat (payload = vectors) and
+    ivf_pq (payload = packed code rows).
+    """
+    store, ids, new_sizes, counts = _scatter_append_core(
+        store, ids, list_sizes, new_rows, new_ids, labels)
+    labels = labels.astype(jnp.int32)
     if adaptive:
         # Running-mean drift (ivf_flat_types.hpp:53-58): with the center
         # equal to the mean of its members before the append, the
         # size-weighted update keeps it the mean after — no pass over the
         # existing rows needed.
         sums = jax.ops.segment_sum(new_rows.astype(centers.dtype), labels,
-                                   num_segments=n_lists)
+                                   num_segments=store.shape[0])
         tot = jnp.maximum(new_sizes.astype(centers.dtype), 1.0)
         upd = (centers * list_sizes.astype(centers.dtype)[:, None] + sums) \
             / tot[:, None]

@@ -140,7 +140,10 @@ class SearchParams:
     ``uint8`` for lut_dtype — an affine per-(query, subspace) quantized LUT,
     the analog of the reference's fp_8bit, ivf_pq_search.cuh:70);
     lower-precision LUTs trade recall for VMEM footprint exactly like the
-    reference's fp8/fp16 LUT options."""
+    reference's fp8/fp16 LUT options. ``internal_distance_dtype`` is the
+    dtype scores are accumulated and top-k-carried in on the LUT scan
+    path (bf16/f16 halve the score-tensor bandwidth; returned distances
+    are always f32); unsupported dtypes raise."""
 
     n_probes: int = 20
     lut_dtype: object = jnp.float32
@@ -150,6 +153,24 @@ class SearchParams:
     # (Index.reconstructed) instead of LUT gathers; "scan" is the LUT path.
     engine: str = "auto"
     bucket_cap: int = 0
+
+
+def validate_search_dtypes(params: "SearchParams"):
+    """Validate the LUT/score dtype knobs (ref: the smem_lut_dtype /
+    score_t dispatch, ivf_pq_types.hpp:122-131) — shared by the
+    single-device and sharded search entries. Returns the two dtypes."""
+    internal_dtype = jnp.dtype(params.internal_distance_dtype)
+    expects(internal_dtype in (jnp.dtype(jnp.float32),
+                               jnp.dtype(jnp.bfloat16),
+                               jnp.dtype(jnp.float16)),
+            "internal_distance_dtype must be float32, bfloat16 or float16 "
+            f"(got {internal_dtype}); ref ivf_pq_types.hpp:122-131")
+    lut_dtype = jnp.dtype(params.lut_dtype)
+    expects(lut_dtype in
+            (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16),
+             jnp.dtype(jnp.float16), jnp.dtype(jnp.uint8)),
+            f"lut_dtype must be f32/bf16/f16/u8 (got {params.lut_dtype})")
+    return lut_dtype, internal_dtype
 
 
 @dataclass
@@ -590,9 +611,12 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     return index
 
 
-def _lut_scores(lut, codes, scale=None):
+def _lut_scores(lut, codes, scale=None, acc_dtype=jnp.float32):
     """score[q, c] = Σ_j LUT[q, j, codes[q, c, j]] (+ per-subspace affine
     ``scale`` for the u8 LUT) via per-subspace one-hot matmuls on the MXU.
+    ``acc_dtype`` is the accumulation dtype (search_params.
+    internal_distance_dtype, ivf_pq_types.hpp:122-131 — half accumulation
+    halves the score-tensor bandwidth at a bounded recall cost).
 
     Resolves the gather-vs-one-hot decision point flagged in SURVEY.md §7:
     measured ~9× faster than ``take_along_axis`` gathers on TPU v5e at the
@@ -601,25 +625,26 @@ def _lut_scores(lut, codes, scale=None):
     mesh) the gather formulation wins, so dispatch follows the backend.
     """
     J, B = lut.shape[1], lut.shape[2]
+    acc_dtype = jnp.dtype(acc_dtype)
 
     if jax.default_backend() != "tpu":
         g = jnp.take_along_axis(lut, codes.transpose(0, 2, 1).astype(
-            jnp.int32), axis=2).astype(jnp.float32)
+            jnp.int32), axis=2).astype(acc_dtype)
         if scale is not None:
-            g = g * scale[:, :, None]
+            g = g * scale[:, :, None].astype(acc_dtype)
         return jnp.sum(g, axis=1)
 
     def body(acc, j):
         oh = jax.nn.one_hot(codes[:, :, j], B, dtype=lut.dtype)
         term = jnp.einsum("qcb,qb->qc", oh, lut[:, j],
                           precision=lax.Precision.HIGHEST,
-                          preferred_element_type=jnp.float32)
+                          preferred_element_type=acc_dtype)
         if scale is not None:
-            term = term * scale[:, j][:, None]
+            term = term * scale[:, j][:, None].astype(acc_dtype)
         return acc + term, None
 
     acc, _ = lax.scan(
-        body, jnp.zeros((codes.shape[0], codes.shape[1]), jnp.float32),
+        body, jnp.zeros((codes.shape[0], codes.shape[1]), acc_dtype),
         jnp.arange(J))
     return acc
 
@@ -640,11 +665,11 @@ def _select_clusters(args, n_probes: int, is_ip: bool):
     return probe_ids
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _pq_probe_scan(
     rotq, probe_ids, pq_codes, indices, list_sizes,
     k: int, is_ip: bool, per_cluster: bool, lut_dtype,
-    pq_dim: int, pq_bits: int,
+    pq_dim: int, pq_bits: int, internal_dtype=jnp.float32,
     pq_centers=None, centers_rot=None,
 ):
     """LUT-scored probe scan (ref: compute_similarity_kernel,
@@ -662,7 +687,10 @@ def _pq_probe_scan(
     q, rot_dim = rotq.shape
     n_lists, cap, _ = pq_codes.shape
     pq_len = rot_dim // pq_dim
-    worst = -jnp.inf if is_ip else jnp.inf
+    internal_dtype = jnp.dtype(internal_dtype)
+    # ±inf exists in bf16/fp16; the carried best-k and per-step scores live
+    # in internal_dtype (the reference's score_t, ivf_pq_types.hpp:122-131).
+    worst = jnp.array(-jnp.inf if is_ip else jnp.inf, internal_dtype)
     slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
     rq3 = rotq.reshape(q, pq_dim, pq_len)
 
@@ -707,11 +735,14 @@ def _pq_probe_scan(
             lut_q = jnp.round(
                 (lut - lmin) / jnp.maximum(scale, 1e-30)).astype(jnp.uint8)
             scores = (_lut_scores(lut_q.astype(jnp.bfloat16), codes,
-                                  scale=scale[..., 0])
-                      + jnp.sum(lmin[..., 0], axis=1)[:, None])
+                                  scale=scale[..., 0],
+                                  acc_dtype=internal_dtype)
+                      + jnp.sum(lmin[..., 0], axis=1)[:, None]
+                      .astype(internal_dtype))
         else:
-            scores = _lut_scores(lut.astype(lut_dtype), codes)
-        scores = scores + qc[:, None]
+            scores = _lut_scores(lut.astype(lut_dtype), codes,
+                                 acc_dtype=internal_dtype)
+        scores = scores + qc[:, None].astype(internal_dtype)
         scores = jnp.where(invalid, worst, scores)
         cat_d = jnp.concatenate([best_d, scores], axis=1)
         cat_i = jnp.concatenate([best_i, ids], axis=1)
@@ -720,10 +751,12 @@ def _pq_probe_scan(
         return (jnp.take_along_axis(cat_d, pos, axis=1),
                 jnp.take_along_axis(cat_i, pos, axis=1)), None
 
-    init = (jnp.full((q, k), worst, jnp.float32),
+    init = (jnp.full((q, k), worst, internal_dtype),
             jnp.full((q, k), -1, indices.dtype))
     (best_d, best_i), _ = lax.scan(body, init, probe_ids.T)
-    return best_d, best_i
+    # Distances are reported f32 regardless of the internal accumulation
+    # dtype (the reference's postprocess_distances writes float).
+    return best_d.astype(jnp.float32), best_i
 
 
 @traced
@@ -737,6 +770,7 @@ def search(
     postprocess_distances (:401)."""
     Q = _as_float(queries)
     expects(Q.ndim == 2 and Q.shape[1] == index.dim, "query dim mismatch")
+    lut_dtype, internal_dtype = validate_search_dtypes(params)
     n_probes = min(params.n_probes, index.n_lists)
     # Static capacity clamp keeps search traceable (jit/scan over query
     # batches); empty slots are masked inside _pq_probe_scan.
@@ -752,9 +786,8 @@ def search(
     # knobs are at their defaults — an explicit lut_dtype/internal dtype
     # request (fp16/bf16/uint8) is honored by the LUT scan path (an explicit
     # engine="bucketed" overrides, documented on SearchParams).
-    default_dtypes = (jnp.dtype(params.lut_dtype) == jnp.float32
-                      and jnp.dtype(params.internal_distance_dtype)
-                      == jnp.float32)
+    default_dtypes = (lut_dtype == jnp.float32
+                      and internal_dtype == jnp.float32)
     recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
         * index.rot_dim * 2
     engine, cap_q = _pick_engine(
@@ -786,7 +819,8 @@ def search(
             rq, pid,
             index.pq_codes, index.indices, index.list_sizes,
             k, is_ip, index.codebook_kind == CodebookGen.PER_CLUSTER,
-            jnp.dtype(params.lut_dtype), index.pq_dim, index.pq_bits,
+            lut_dtype, index.pq_dim, index.pq_bits,
+            internal_dtype,
             pq_centers=index.pq_centers, centers_rot=centers_rot,
         ),
         rotq, probe_ids, per_q)

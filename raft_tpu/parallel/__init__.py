@@ -12,9 +12,13 @@ from raft_tpu.parallel.ivf import (
     ShardedIvfFlat,
     ShardedIvfPq,
     sharded_ivf_flat_build,
+    sharded_ivf_flat_extend,
     sharded_ivf_flat_search,
+    sharded_ivf_load,
     sharded_ivf_pq_build,
+    sharded_ivf_pq_extend,
     sharded_ivf_pq_search,
+    sharded_ivf_save,
 )
 
 __all__ = [
@@ -23,4 +27,6 @@ __all__ = [
     "ShardedIvfFlat", "ShardedIvfPq",
     "sharded_ivf_flat_build", "sharded_ivf_flat_search",
     "sharded_ivf_pq_build", "sharded_ivf_pq_search",
+    "sharded_ivf_flat_extend", "sharded_ivf_pq_extend",
+    "sharded_ivf_save", "sharded_ivf_load",
 ]

@@ -230,10 +230,11 @@ def sharded_ivf_pq_build(
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes", "is_ip",
                               "per_cluster", "pq_dim", "pq_bits", "sqrt",
-                              "lut_dtype"))
+                              "lut_dtype", "internal_dtype"))
 def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
                            mesh, axis, k, n_probes, is_ip, per_cluster,
-                           pq_dim, pq_bits, sqrt, lut_dtype):
+                           pq_dim, pq_bits, sqrt, lut_dtype,
+                           internal_dtype=jnp.float32):
     n_dev = mesh.shape[axis]
 
     def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q):
@@ -245,7 +246,7 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
         kk = min(k, codes_l.shape[0] * codes_l.shape[1])
         d, i = _pq._pq_probe_scan(
             rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
-            lut_dtype, pq_dim, pq_bits,
+            lut_dtype, pq_dim, pq_bits, internal_dtype,
             pq_centers=books_r, centers_rot=centers_rot)
         all_d = lax.all_gather(d, axis, axis=1, tiled=True)
         all_i = lax.all_gather(i, axis, axis=1, tiled=True)
@@ -271,6 +272,7 @@ def sharded_ivf_pq_search(
     returns replicated global-id results."""
     Q = _pq._as_float(_pq.as_array(queries))
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
+    lut_dtype, internal_dtype = _pq.validate_search_dtypes(params)
     n_probes = min(params.n_probes, index.centers.shape[0])
     k = min(k, index.indices.shape[0] * index.indices.shape[1]
             * index.indices.shape[2])
@@ -282,4 +284,179 @@ def sharded_ivf_pq_search(
         per_cluster=index.codebook_kind == _pq.CodebookGen.PER_CLUSTER,
         pq_dim=index.pq_dim, pq_bits=index.pq_bits,
         sqrt=index.metric == DistanceType.L2SqrtExpanded,
-        lut_dtype=jnp.dtype(params.lut_dtype))
+        lut_dtype=lut_dtype, internal_dtype=internal_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Sharded lifecycle: extend + save/load (ref: the MNMG pattern persists and
+# grows per-rank state with the same versioned serializers as the
+# single-device index, detail/ivf_pq_serialize.cuh:38-100).
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _sharded_scatter_append(store, ids, sizes, payload, new_ids, labels):
+    """vmapped O(n_new) append over the shard axis; ``store``/``ids`` are
+    donated so each shard's buffer is updated in place (see
+    ivf_flat._scatter_append_core)."""
+    st, id_, sz, _ = jax.vmap(_flat._scatter_append_core)(
+        store, ids, sizes, payload, new_ids, labels)
+    return st, id_, sz
+
+
+def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
+    """Shared grow+append for both sharded index kinds. ``payload`` is the
+    per-row storage payload (vectors / packed code rows), already encoded;
+    rows are dealt to shards contiguously (n_new % n_dev == 0, the build
+    contract)."""
+    axis = index.axis
+    n_dev = mesh.shape[axis]
+    store = getattr(index, store_name)
+    n_new = payload.shape[0]
+    expects(n_new % n_dev == 0, "rows must divide the mesh axis (pad first)")
+    m = n_new // n_dev
+    pl = payload.reshape(n_dev, m, payload.shape[1])
+    ni = new_ids.reshape(n_dev, m)
+    lb = labels.reshape(n_dev, m).astype(jnp.int32)
+
+    # Common-capacity growth across shards (one scalar readback —
+    # _grown_cap's max reduces over the stacked (n_dev, n_lists) sizes).
+    counts = jax.vmap(
+        lambda l: jnp.bincount(l, length=store.shape[1]))(lb)
+    cap = store.shape[2]
+    new_cap = _flat._grown_cap(index.list_sizes, counts, cap,
+                               conservative=False)
+    sharding = NamedSharding(mesh, P(axis))
+    if new_cap > cap:
+        store = jax.device_put(
+            jnp.pad(store, ((0, 0), (0, 0), (0, new_cap - cap))
+                    + ((0, 0),) * (store.ndim - 3)), sharding)
+        index.indices = jax.device_put(
+            jnp.pad(index.indices, ((0, 0), (0, 0), (0, new_cap - cap)),
+                    constant_values=-1), sharding)
+    st, id_, sz = _sharded_scatter_append(
+        store, index.indices, index.list_sizes, pl, ni, lb)
+    setattr(index, store_name, st)
+    index.indices, index.list_sizes = id_, sz
+    return index
+
+
+def sharded_ivf_flat_extend(mesh: Mesh, index: ShardedIvfFlat, new_vectors,
+                            new_indices=None) -> ShardedIvfFlat:
+    """Append rows to the sharded index in place at O(n_new) per shard
+    (ref: ivf_flat::extend + the MNMG shard recipe). New rows are dealt
+    contiguously across shards and scatter into each shard's free list
+    slots; the shared coarse model is unchanged."""
+    X = _flat._as_float(_flat.as_array(new_vectors))
+    expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
+    if new_indices is None:
+        base = int(jnp.sum(index.list_sizes))
+        new_indices = jnp.arange(base, base + X.shape[0],
+                                 dtype=index.indices.dtype)
+    else:
+        new_indices = jnp.asarray(new_indices).astype(index.indices.dtype)
+    labels = kmeans_balanced.predict(
+        KMeansBalancedParams(metric=index.metric), index.centers, X)
+    return _sharded_extend(mesh, index, "data", X, new_indices, labels)
+
+
+def sharded_ivf_pq_extend(mesh: Mesh, index: ShardedIvfPq, new_vectors,
+                          new_indices=None) -> ShardedIvfPq:
+    """Encode + append rows to the sharded PQ index in place (ref:
+    ivf_pq::extend against the replicated model)."""
+    X = _pq._as_float(_pq.as_array(new_vectors))
+    expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
+    if new_indices is None:
+        base = int(jnp.sum(index.list_sizes))
+        new_indices = jnp.arange(base, base + X.shape[0],
+                                 dtype=index.indices.dtype)
+    else:
+        new_indices = jnp.asarray(new_indices).astype(index.indices.dtype)
+    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
+    labels = kmeans_balanced.predict(kb, index.centers, X)
+    res = _pq._residuals(X, labels, index.centers, index.rotation_matrix,
+                         index.pq_dim)
+    if index.codebook_kind == _pq.CodebookGen.PER_SUBSPACE:
+        codes = _pq._encode(res, index.pq_centers)
+    else:
+        codes = _pq._encode_per_cluster(res, labels, index.pq_centers)
+    codes = _pq.pack_codes(codes, index.pq_bits)
+    return _sharded_extend(mesh, index, "pq_codes", codes, new_indices,
+                           labels)
+
+
+SHARDED_SERIALIZATION_VERSION = 1
+
+
+def sharded_ivf_save(basename: str, index) -> None:
+    """Persist a sharded index: one ``<base>.model.npz`` with the
+    replicated model + metadata, and ``<base>.shard{i}.npz`` per shard —
+    the per-rank layout of the reference's MNMG serializers
+    (detail/ivf_pq_serialize.cuh:38). Works for ShardedIvfFlat and
+    ShardedIvfPq."""
+    is_pq = isinstance(index, ShardedIvfPq)
+    model = dict(
+        version=np.int64(SHARDED_SERIALIZATION_VERSION),
+        kind=np.str_("pq" if is_pq else "flat"),
+        metric=np.int64(index.metric.value),
+        axis=np.str_(index.axis),
+        n_shards=np.int64(index.indices.shape[0]),
+        centers=np.asarray(index.centers),
+    )
+    if is_pq:
+        model.update(
+            codebook_kind=np.int64(index.codebook_kind.value),
+            rotation_matrix=np.asarray(index.rotation_matrix),
+            pq_centers=np.asarray(index.pq_centers),
+            pq_bits=np.int64(index.pq_bits),
+            pq_dim=np.int64(index.pq_dim),
+        )
+    np.savez(f"{basename}.model.npz", **model)
+    store = np.asarray(index.pq_codes if is_pq else index.data)
+    ids = np.asarray(index.indices)
+    sizes = np.asarray(index.list_sizes)
+    for s in range(store.shape[0]):
+        np.savez(f"{basename}.shard{s}.npz", store=store[s],
+                 indices=ids[s], list_sizes=sizes[s])
+
+
+def sharded_ivf_load(mesh: Mesh, basename: str):
+    """Load a sharded index saved by :func:`sharded_ivf_save`, re-placing
+    the shard tensors over ``mesh`` (the shard count must match the mesh
+    axis size, like rank-count-pinned MNMG deserialization)."""
+    with np.load(f"{basename}.model.npz") as m:
+        version = int(m["version"])
+        expects(version == SHARDED_SERIALIZATION_VERSION,
+                f"sharded serialization version mismatch: {version}")
+        kind = str(m["kind"])
+        axis = str(m["axis"])
+        n_shards = int(m["n_shards"])
+        expects(mesh.shape[axis] == n_shards,
+                f"index has {n_shards} shards but mesh[{axis!r}] = "
+                f"{mesh.shape[axis]}")
+        model = {k: m[k] for k in m.files}
+    shards = [np.load(f"{basename}.shard{s}.npz") for s in range(n_shards)]
+    sharding = NamedSharding(mesh, P(axis))
+    ids_h = np.stack([z["indices"] for z in shards])
+    # int64 ids require x64 — without the guard jnp.asarray silently
+    # truncates (same contract as ivf_flat.load / ivf_pq.load).
+    validate_idx_dtype(ids_h.dtype)
+    store = jax.device_put(
+        jnp.asarray(np.stack([z["store"] for z in shards])), sharding)
+    ids = jax.device_put(jnp.asarray(ids_h), sharding)
+    sizes = jax.device_put(
+        jnp.asarray(np.stack([z["list_sizes"] for z in shards])), sharding)
+    for z in shards:
+        z.close()
+    centers = jnp.asarray(model["centers"])
+    if kind == "pq":
+        return ShardedIvfPq(
+            metric=DistanceType(int(model["metric"])),
+            codebook_kind=_pq.CodebookGen(int(model["codebook_kind"])),
+            centers=centers,
+            rotation_matrix=jnp.asarray(model["rotation_matrix"]),
+            pq_centers=jnp.asarray(model["pq_centers"]),
+            pq_codes=store, indices=ids, list_sizes=sizes,
+            pq_bits=int(model["pq_bits"]), pq_dim=int(model["pq_dim"]),
+            axis=axis)
+    return ShardedIvfFlat(
+        metric=DistanceType(int(model["metric"])), centers=centers,
+        data=store, indices=ids, list_sizes=sizes, axis=axis)

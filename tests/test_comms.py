@@ -174,6 +174,57 @@ class TestShardedAlgos:
                          for r in range(len(q))])
         assert agree > 0.98, agree
 
+    def test_sharded_ivf_lifecycle_extend_save_load(self, mesh, rng,
+                                                    tmp_path):
+        """MNMG lifecycle parity: extend a sharded index in place, persist
+        per-shard npz + replicated model, reload onto the mesh (ref:
+        detail/ivf_pq_serialize.cuh:38-100 per-rank serializers)."""
+        from raft_tpu.neighbors import ivf_flat, ivf_pq
+        from raft_tpu.parallel import (
+            sharded_ivf_flat_build, sharded_ivf_flat_extend,
+            sharded_ivf_flat_search, sharded_ivf_load, sharded_ivf_pq_build,
+            sharded_ivf_pq_extend, sharded_ivf_pq_search, sharded_ivf_save)
+
+        db = rng.normal(size=(2048, 24)).astype(np.float32)
+        extra = rng.normal(size=(512, 24)).astype(np.float32)
+        q = rng.normal(size=(30, 24)).astype(np.float32)
+        full = np.concatenate([db, extra])
+        dn = ((q[:, None, :] - full[None]) ** 2).sum(-1)
+        truth = np.argsort(dn, axis=1)[:, :10]
+
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=5)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        sharded = sharded_ivf_flat_extend(mesh, sharded, extra)
+        assert int(np.sum(np.asarray(sharded.list_sizes))) == 2560
+        sp = ivf_flat.SearchParams(n_probes=16)
+        d, i = sharded_ivf_flat_search(mesh, sp, sharded, q, 10)
+        found = np.asarray(i)
+        hits = sum(len(np.intersect1d(found[r], truth[r])) for r in range(30))
+        assert hits / truth.size > 0.99  # all lists probed -> exact
+
+        base = str(tmp_path / "sharded_flat")
+        sharded_ivf_save(base, sharded)
+        loaded = sharded_ivf_load(mesh, base)
+        d2, i2 = sharded_ivf_flat_search(mesh, sp, loaded, q, 10)
+        np.testing.assert_array_equal(found, np.asarray(i2))
+
+        pq_params = ivf_pq.IndexParams(n_lists=16, pq_dim=8,
+                                       kmeans_n_iters=5)
+        spq = sharded_ivf_pq_build(mesh, pq_params, db)
+        spq = sharded_ivf_pq_extend(mesh, spq, extra)
+        assert int(np.sum(np.asarray(spq.list_sizes))) == 2560
+        sppq = ivf_pq.SearchParams(n_probes=16, engine="scan")
+        pd, pi = sharded_ivf_pq_search(mesh, sppq, spq, q, 10)
+        hits = sum(len(np.intersect1d(np.asarray(pi)[r], truth[r]))
+                   for r in range(30))
+        assert hits / truth.size > 0.6  # PQ quantization bound
+
+        base = str(tmp_path / "sharded_pq")
+        sharded_ivf_save(base, spq)
+        ploaded = sharded_ivf_load(mesh, base)
+        pd2, pi2 = sharded_ivf_pq_search(mesh, sppq, ploaded, q, 10)
+        np.testing.assert_array_equal(np.asarray(pi), np.asarray(pi2))
+
     def test_graft_entry_dryrun(self):
         import __graft_entry__ as ge
 

@@ -136,6 +136,41 @@ class TestIvfPq:
             index, q, 10)
         assert _recall(np.asarray(i), truth) > 0.6
 
+    @pytest.mark.parametrize("idt", ["bfloat16", "float16"])
+    def test_internal_distance_dtype_recall_grid(self, dataset, idt):
+        """Half-precision score accumulation stays within a bounded recall
+        drop of f32 and reports f32 distances (the reference's
+        internal_distance_dtype recall grid, ann_ivf_pq.cuh:257-265)."""
+        import jax.numpy as jnp
+
+        db, q, truth = dataset
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=16, kmeans_n_iters=10)
+        index = ivf_pq.build(params, db)
+        r = {}
+        for name, dt in (("f32", jnp.float32), (idt, jnp.dtype(idt))):
+            d, i = ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=32, engine="scan",
+                                    internal_distance_dtype=dt),
+                index, q, 10)
+            assert np.asarray(d).dtype == np.float32
+            r[name] = _recall(np.asarray(i), truth)
+        assert r[idt] >= r["f32"] - 0.05, r
+        assert r[idt] > 0.6, r
+
+    def test_internal_distance_dtype_rejects_unsupported(self, dataset):
+        import jax.numpy as jnp
+
+        from raft_tpu.core.error import RaftError
+
+        db, q, _ = dataset
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=2)
+        index = ivf_pq.build(params, db[:2000])
+        with pytest.raises(RaftError, match="internal_distance_dtype"):
+            ivf_pq.search(
+                ivf_pq.SearchParams(n_probes=8,
+                                    internal_distance_dtype=jnp.int32),
+                index, q, 5)
+
     def test_extend(self, dataset):
         db, q, truth = dataset
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=10,
