@@ -6,14 +6,21 @@ matrix/detail/select_k.cuh:67-87 choosing between a warp-level bitonic sort
 filter (select_radix.cuh) for large batch×len×k.
 
 TPU-native re-design: the warp bitonic network and radix passes are CUDA
-register/smem idioms with no TPU analog. Two engines:
+register/smem idioms with no TPU analog. Three engines:
 
-* ``jax.lax.top_k`` (XLA's sort-based top-k) — measured fastest at every
-  probed shape on v5e and CPU, so ``kAuto`` always resolves here;
-* ``kTwoPhase`` (explicit opt-in): per-chunk ``top_k`` over VPU-friendly
-  tiles (phase 1 compresses len → n_chunks·k candidates), then a final
-  ``top_k`` over candidates — the radix filter's work-compression idea on
-  dense primitives, kept for shapes/backends where it may win.
+* ``jax.lax.top_k`` (XLA's sort-based top-k) — fastest at small k and
+  short rows; the ``kAuto`` default there;
+* ``kStream`` — the large-len path (the select_radix role): a Pallas
+  sweep extracts each 512-chunk's 8 smallest in VMEM (n → n/64
+  candidates at memory-floor HBM traffic, no sort network), a small
+  ``top_k`` ranks the candidates, and an exactness audit falls back to a
+  full ``top_k`` inside ``lax.cond`` on pathological skew (sorted input,
+  mass ties) — so the result is always exactly ``lax.top_k``'s,
+  including tie order. ``kAuto`` dispatches here for k ≥ 64 and
+  len ≥ 65536 on TPU (measured 4.3× over ``top_k`` at batch=64,
+  len=131072, k=128; 1.5–30× across the probed region);
+* ``kTwoPhase`` (explicit opt-in): per-chunk ``top_k`` then a final
+  merge ``top_k`` — kept for shapes/backends where it may win.
 
 ``select_min`` is handled by key negation (floats) / complement (ints) so a
 single largest-k kernel serves both polarities, like the reference's
@@ -23,10 +30,13 @@ single largest-k kernel serves both polarities, like the reference's
 from __future__ import annotations
 
 import enum
+import functools
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.util.pow2 import ceildiv
@@ -39,16 +49,12 @@ class SelectMethod(enum.Enum):
     kAuto = 0
     kTopK = 1       # direct lax.top_k (analog of warpsort path)
     kTwoPhase = 2   # chunked candidate compression (analog of radix path)
+    kStream = 3     # Pallas streaming k-pass select (large-len path)
 
 
 # Chunk length for the two-phase path: big enough to amortize sort overhead,
 # small enough that n_chunks*k candidates stay tiny vs len.
 _CHUNK = 16384
-# Measured on v5e (batch=64, len=131072, k=128: top_k 4.7 ms vs two-phase
-# 7.4 ms) and on CPU: XLA's top_k beats the chunked compression at every
-# probed shape, so kAuto resolves to the direct path; kTwoPhase stays as an
-# explicit option (the analog of forcing the reference's radix algo via
-# SelectAlgo).
 
 
 def _to_descending_keys(v: jax.Array, select_min: bool) -> jax.Array:
@@ -100,6 +106,158 @@ def _two_phase_top_k(values, k, select_min, chunk=_CHUNK):
     return sel, idx
 
 
+# Streaming engine geometry: each grid cell loads a _BT-lane tile holding
+# _NSUB sub-chunks of _SUB lanes, extracts the _M smallest of every
+# sub-chunk in parallel, and writes exactly one dense 128-lane candidate
+# block (_NSUB · _M == 128 — no padded lanes, and lane stores stay
+# 128-aligned as Mosaic requires).
+_SUB = 512
+_M = 8
+_NSUB = 128 // _M
+_BT = _SUB * _NSUB
+_I32MAX = jnp.iinfo(jnp.int32).max
+
+
+def _mextract_kernel(v_ref, outv_ref, outi_ref, *, n: int):
+    """One (batch-block, tile) grid cell: for each of the tile's _NSUB
+    sub-chunks, extract its _M smallest (value, index) pairs — ascending,
+    ties to the lowest index, matching ``lax.top_k``'s stable order —
+    entirely in VMEM. Sub-chunk s's extracts land at lanes
+    [s·_M, (s+1)·_M) of the dense 128-lane candidate block, so the tile's
+    data is touched once and every output lane is real (memory-floor HBM
+    traffic; no sort network runs anywhere). All ops stay 2-D — Mosaic
+    cannot fold a (bq, _NSUB, _M) register tile into lanes."""
+    j = pl.program_id(1)
+    bq = v_ref.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (bq, _SUB), 1)
+    col128 = jax.lax.broadcasted_iota(jnp.int32, (bq, 128), 1)
+
+    def body_sub(sub, carry):
+        vd, vi = carry
+        w = v_ref[:, pl.ds(sub * _SUB, _SUB)].astype(jnp.float32)
+        ids = j * _BT + sub * _SUB + col
+        w = jnp.where(ids < n, w, jnp.inf)
+
+        def body_t(t, c2):
+            w, vd, vi = c2
+            cur = jnp.min(w, axis=1, keepdims=True)
+            hit = w == cur
+            sel = jnp.min(jnp.where(hit, ids, _I32MAX), axis=1,
+                          keepdims=True)
+            w = jnp.where(ids == sel, jnp.inf, w)
+            put = col128 == sub * _M + t
+            vd = jnp.where(put, cur, vd)
+            vi = jnp.where(put, sel, vi)
+            return w, vd, vi
+
+        _, vd, vi = jax.lax.fori_loop(0, _M, body_t, (w, vd, vi))
+        return vd, vi
+
+    vd0 = jnp.full((bq, 128), jnp.inf, jnp.float32)
+    vi0 = jnp.full((bq, 128), -1, jnp.int32)
+    vd, vi = jax.lax.fori_loop(0, _NSUB, body_sub, (vd0, vi0))
+    outv_ref[:] = vd
+    outi_ref[:] = vi
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _stream_select_min(values, k: int, interpret: bool = False):
+    """Streaming min-k over f32 keys: (batch, n) → ascending (batch, k)
+    values + positional indices, exact.
+
+    The TPU re-design of the reference's multi-pass radix filter
+    (matrix/detail/select_radix.cuh): a Pallas sweep extracts each
+    512-chunk's 8 smallest in VMEM (the work-compression pass — n →
+    n/64 candidates at memory-floor HBM traffic), one small ``top_k``
+    ranks the candidates, and an exactness audit catches the only way
+    compression can lose an element: a chunk whose 8th-smallest still
+    beats the candidate k-th. Such rows fall back to a full ``top_k``
+    inside ``lax.cond`` (both branches compiled, one executed — the
+    radix kernel's extra passes, paid only on pathological skew such as
+    sorted input; on typical data the audit passes and the fast path is
+    final). k ≤ 256 (the reference warpsort cap, select_warpsort.cuh:100).
+    """
+    from raft_tpu.util.pow2 import round_up_safe
+
+    batch, n = values.shape
+    bq = min(round_up_safe(batch, 8), 64)
+    bp = round_up_safe(batch, bq)
+    np_ = round_up_safe(n, _BT)
+    if bp != batch or np_ != n:
+        values = jnp.pad(values, ((0, bp - batch), (0, np_ - n)),
+                         constant_values=jnp.inf)
+    nt = np_ // _BT                      # tiles per row
+    nc = nt * _NSUB                      # sub-chunks per row
+
+    kernel = functools.partial(_mextract_kernel, n=n)
+    cand_v, cand_i = pl.pallas_call(
+        kernel,
+        grid=(bp // bq, nt),
+        in_specs=[pl.BlockSpec((bq, _BT), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((bq, 128), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((bq, 128), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bp, nc * _M), jnp.float32),
+            jax.ShapeDtypeStruct((bp, nc * _M), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(values)
+    cand_v = cand_v[:batch]
+    cand_i = cand_i[:batch]
+
+    neg, pos = jax.lax.top_k(-cand_v, k)
+    best_v = -neg
+    best_i = jnp.take_along_axis(cand_i, pos, axis=1)
+
+    # Exactness audit: chunk slots are ascending, so slot _M-1 is each
+    # chunk's worst extract; if any still ties-or-beats the candidate
+    # k-th, that chunk may hide a better element (<= keeps tie order
+    # identical to lax.top_k's lowest-index rule).
+    chunk_worst = cand_v.reshape(batch, nc, _M)[:, :, _M - 1]
+    exact = jnp.all(chunk_worst > best_v[:, k - 1:k])
+
+    def fast(_):
+        return best_v, best_i
+
+    def slow(_):
+        nv, ni = jax.lax.top_k(-values[:batch], k)
+        return -nv, ni.astype(jnp.int32)
+
+    return jax.lax.cond(exact, fast, slow, None)
+
+
+def _stream_top_k(values, k, select_min):
+    """kStream engine: negate keys for max-selection, stream-select, gather
+    original values at the selected positions. With k < n (the dispatch
+    precondition) the selected indices are always real positions: padding
+    keys are +inf and lose every min-comparison, and rows whose candidate
+    set degenerates (mass ±inf) trip the audit into the exact fallback."""
+    keys = values.astype(jnp.float32)
+    if not select_min:
+        keys = -keys
+    interpret = jax.default_backend() != "tpu"
+    _, idx = _stream_select_min(keys, k, interpret=interpret)
+    return jnp.take_along_axis(values, idx, axis=-1), idx
+
+
+def _stream_supported(batch: int, n: int, k: int, dtype) -> bool:
+    """kAuto crossover (measured on v5e): the streaming extractor wins on
+    long rows at large k, where XLA's top_k pays a full k-insertion sort
+    per row (probed 1.5–30×, e.g. 4.3× at batch=64, len=131072, k=128);
+    at small k XLA's partial sort is already cheap and keeps winning.
+    Needs n/64 candidates ≥ 2k for audit headroom."""
+    return (jax.default_backend() == "tpu" and 64 <= k <= 256
+            and n >= 65536 and n >= 128 * k and batch >= 8
+            and jnp.issubdtype(jnp.dtype(dtype), jnp.floating))
+
+
 @traced
 def select_k(
     values,
@@ -135,9 +293,27 @@ def select_k(
                 [idx, jnp.full((batch, k - n), n, jnp.int32)], axis=1
             )
     else:
-        use_two_phase = method == SelectMethod.kTwoPhase
-        if use_two_phase:
+        if method == SelectMethod.kStream:
+            # Explicit engine request: validate rather than silently
+            # degrade (integer keys would round through f32; too few
+            # candidates would crash in the merge top_k).
+            from raft_tpu.core.error import expects
+            from raft_tpu.util.pow2 import round_up_safe
+
+            expects(k <= 256,
+                    "kStream supports k <= 256 (the warpsort cap)")
+            expects(jnp.issubdtype(v.dtype, jnp.floating),
+                    "kStream requires floating-point values "
+                    "(integer keys are not exact in its f32 pipeline)")
+            expects(round_up_safe(n, _BT) // _SUB * _M >= k,
+                    f"kStream needs len/64 candidates >= k (len={n}, "
+                    f"k={k}); use kTopK")
+        if method == SelectMethod.kTwoPhase:
             sel, idx = _two_phase_top_k(v, k, select_min)
+        elif method == SelectMethod.kStream or (
+                method == SelectMethod.kAuto
+                and _stream_supported(batch, n, k, v.dtype)):
+            sel, idx = _stream_top_k(v, k, select_min)
         else:
             sel, idx = _direct_top_k(v, k, select_min)
     if indices is not None:

@@ -556,6 +556,25 @@ def _invalidate_caches(index: Index) -> None:
     index.__dict__.pop("_auto_cap_cache", None)
 
 
+def encode_rows(model, X) -> Tuple[jax.Array, jax.Array]:
+    """Assign + encode rows against a trained model: returns ``(labels,
+    packed code rows)``. The single definition of the
+    predict→residual→encode→pack pipeline (ref: process_and_fill_codes,
+    ivf_pq_build.cuh:724) shared by ``extend``, the sharded build and the
+    sharded extend — ``model`` is any object with centers /
+    rotation_matrix / pq_centers / codebook_kind / pq_dim / pq_bits
+    (an Index or a ShardedIvfPq)."""
+    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
+    labels = kmeans_balanced.predict(kb, model.centers, X)
+    res = _residuals(X, labels, model.centers, model.rotation_matrix,
+                     model.pq_dim)
+    if model.codebook_kind == CodebookGen.PER_SUBSPACE:
+        codes = _encode(res, model.pq_centers)
+    else:
+        codes = _encode_per_cluster(res, labels, model.pq_centers)
+    return labels, pack_codes(codes, model.pq_bits)
+
+
 @traced
 def extend(index: Index, new_vectors, new_indices=None) -> Index:
     """Encode + append rows in place at O(n_new) amortized cost.
@@ -580,15 +599,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     else:
         new_indices = as_array(new_indices).astype(index.indices.dtype)
 
-    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
-    labels = kmeans_balanced.predict(kb, index.centers, X)
-    res = _residuals(X, labels, index.centers, index.rotation_matrix,
-                     index.pq_dim)
-    if index.codebook_kind == CodebookGen.PER_SUBSPACE:
-        codes = _encode(res, index.pq_centers)
-    else:
-        codes = _encode_per_cluster(res, labels, index.pq_centers)
-    codes = pack_codes(codes, index.pq_bits)
+    labels, codes = encode_rows(index, X)
 
     old_n = index.size
     if not old_n:

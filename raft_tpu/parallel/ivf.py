@@ -206,15 +206,7 @@ def sharded_ivf_pq_build(
         model = _pq.build(dataclasses.replace(params, add_data_on_build=False),
                           X)
 
-    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
-    labels = kmeans_balanced.predict(kb, model.centers, X)
-    res = _pq._residuals(X, labels, model.centers, model.rotation_matrix,
-                         model.pq_dim)
-    if model.codebook_kind == _pq.CodebookGen.PER_SUBSPACE:
-        codes = _pq._encode(res, model.pq_centers)
-    else:
-        codes = _pq._encode_per_cluster(res, labels, model.pq_centers)
-    codes = _pq.pack_codes(codes, model.pq_bits)
+    labels, codes = _pq.encode_rows(model, X)
 
     ids = jnp.arange(n, dtype=model.indices.dtype)
     packed, idx, sizes = _shard_pack(mesh, axis, codes, np.asarray(labels),
@@ -370,15 +362,7 @@ def sharded_ivf_pq_extend(mesh: Mesh, index: ShardedIvfPq, new_vectors,
                                  dtype=index.indices.dtype)
     else:
         new_indices = jnp.asarray(new_indices).astype(index.indices.dtype)
-    kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
-    labels = kmeans_balanced.predict(kb, index.centers, X)
-    res = _pq._residuals(X, labels, index.centers, index.rotation_matrix,
-                         index.pq_dim)
-    if index.codebook_kind == _pq.CodebookGen.PER_SUBSPACE:
-        codes = _pq._encode(res, index.pq_centers)
-    else:
-        codes = _pq._encode_per_cluster(res, labels, index.pq_centers)
-    codes = _pq.pack_codes(codes, index.pq_bits)
+    labels, codes = _pq.encode_rows(index, X)
     return _sharded_extend(mesh, index, "pq_codes", codes, new_indices,
                            labels)
 

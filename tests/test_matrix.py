@@ -82,6 +82,31 @@ class TestSelectK:
         x = rng.standard_normal((2, 70000)).astype(np.float32)
         _check_select(x, 64, True)
 
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_stream_matches_top_k(self, rng, select_min):
+        """kStream (the large-len Pallas extractor; interpret mode on CPU)
+        must reproduce lax.top_k exactly — values, indices, tie order
+        (ref: the select_radix vs warpsort agreement tests,
+        cpp/test/matrix/select_k.cu)."""
+        x = rng.standard_normal((9, 16400)).astype(np.float32)
+        sv, si = select_k(x, 64, select_min, method=SelectMethod.kStream)
+        tv, ti = select_k(x, 64, select_min, method=SelectMethod.kTopK)
+        np.testing.assert_array_equal(np.asarray(si), np.asarray(ti))
+        np.testing.assert_allclose(np.asarray(sv), np.asarray(tv))
+
+    def test_stream_audit_fallback_exact(self, rng):
+        """Pathological inputs (sorted rows: the whole top-k inside one
+        chunk; constant rows: mass ties) must trip the exactness audit and
+        still return lax.top_k's exact result."""
+        n = 16384
+        asc = np.tile(np.arange(n, dtype=np.float32), (8, 1))
+        cst = np.ones((8, n), np.float32)
+        for x in (asc, cst):
+            sv, si = select_k(x, 64, True, method=SelectMethod.kStream)
+            tv, ti = select_k(x, 64, True, method=SelectMethod.kTopK)
+            np.testing.assert_array_equal(np.asarray(si), np.asarray(ti))
+            np.testing.assert_allclose(np.asarray(sv), np.asarray(tv))
+
     def test_k_ge_len(self, rng):
         x = rng.standard_normal((3, 10)).astype(np.float32)
         v, i = select_k(x, 10, select_min=True)
@@ -107,3 +132,38 @@ class TestSelectK:
         np.testing.assert_array_equal(np.asarray(v), np.sort(x, 1)[:, :7])
         v, i = select_k(x, 7, select_min=False)
         np.testing.assert_array_equal(np.asarray(v), np.sort(x, 1)[:, ::-1][:, :7])
+
+
+def test_stream_explicit_validation(rng):
+    """Explicit kStream requests fail loudly on unsupported inputs
+    instead of silently degrading (integer keys) or crashing opaquely
+    (k beyond the candidate budget)."""
+    from raft_tpu.core.error import RaftError
+
+    xi = rng.integers(-100, 100, (8, 70000)).astype(np.int32)
+    with pytest.raises(RaftError, match="floating"):
+        select_k(xi, 64, method=SelectMethod.kStream)
+    xf = rng.standard_normal((8, 1000)).astype(np.float32)
+    with pytest.raises(RaftError, match="candidates"):
+        select_k(xf, 200, method=SelectMethod.kStream)
+    xb = rng.standard_normal((8, 70000)).astype(np.float32)
+    with pytest.raises(RaftError, match="256"):
+        select_k(xb, 300, method=SelectMethod.kStream)
+
+
+def test_stream_inf_values_exact(rng):
+    """Real ±inf inputs survive the stream engine: -inf is the smallest
+    element, not a padding artifact (regression: an isinf mask used to
+    clobber it with the dummy sentinel)."""
+    x = np.zeros((8, 16384), np.float32)
+    x[0, 5] = -np.inf
+    x[1, 7] = np.inf
+    sv, si = select_k(x, 64, True, method=SelectMethod.kStream)
+    tv, ti = select_k(x, 64, True, method=SelectMethod.kTopK)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ti))
+    np.testing.assert_array_equal(np.asarray(sv), np.asarray(tv))
+    assert np.asarray(sv)[0, 0] == -np.inf
+    sv, si = select_k(x, 64, False, method=SelectMethod.kStream)
+    tv, ti = select_k(x, 64, False, method=SelectMethod.kTopK)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ti))
+    assert np.asarray(sv)[1, 0] == np.inf
