@@ -2,13 +2,17 @@
 
 Ref: cpp/bench/common/benchmark.hpp:93-148 — the reference times with
 cudaEvents and flushes L2 between iterations. The TPU device link (axon
-tunnel) adds ~100 ms per synchronized call, so steady-state per-iteration
-time is measured by scanning the op over R distinct input batches *inside
-one jit* (lax.scan) and syncing once via a scalar checksum transfer; the
-link overhead amortizes over R. The distinct batches prevent XLA from
-hoisting the body out of the loop; the checksum keeps it from dead-code
-elimination — the same roles the L2 flush and result consumption play in
-the reference fixture.
+tunnel) costs ~100 ms per *synchronized* call and ``block_until_ready``
+does not fence it, so naive loops measure dispatch, and a scan synced
+once still carries an additive RTT/iters error that silently dominates
+sub-millisecond ops (the root cause of the round-2 "regressions": the
+same ops timed at iters=32 read ~3 ms slower than at iters=256).
+
+This harness therefore (a) syncs via a scalar host transfer — the only
+reliable fence on this link, (b) measures the link RTT once and subtracts
+RTT/iters, (c) auto-scales iters so the residual RTT error is <2% of the
+op time, and (d) reports the median of ≥5 repeats with spread, the
+regression-grade contract of the reference's gbench fixture.
 """
 
 from __future__ import annotations
@@ -21,6 +25,47 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+
+_RTT = None
+
+
+def link_rtt() -> float:
+    """Measured seconds for one trivial dispatch+sync round trip (cached)."""
+    global _RTT
+    if _RTT is None:
+        f = jax.jit(lambda x: x + 1.0)
+        np.asarray(f(jnp.float32(0)))  # compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(jnp.float32(0)))
+            ts.append(time.perf_counter() - t0)
+        _RTT = min(ts)
+    return _RTT
+
+
+def _gather_arrays(obj, out):
+    for leaf in jax.tree_util.tree_leaves(obj):
+        if isinstance(leaf, (jax.Array, np.ndarray)):
+            out.append(leaf)
+        elif hasattr(leaf, "__dict__"):  # Index-style plain dataclasses:
+            for v in vars(leaf).values():  # one level, arrays only (deep
+                if isinstance(v, (jax.Array, np.ndarray)):  # recursion
+                    out.append(v)          # cycles through enum internals)
+
+
+def fence(out) -> None:
+    """Reliable device fence: a scalar checksum over every array reachable
+    from ``out`` (incl. fields of plain dataclasses like the IVF Index) is
+    transferred to the host — completion of a dependent op implies every
+    input buffer is done; ``block_until_ready`` does not fence this link.
+    """
+    arrays: list = []
+    _gather_arrays(out, arrays)
+    s = jnp.float32(0)
+    for a in arrays:
+        s = s + jnp.sum(jnp.asarray(a).ravel()[:1].astype(jnp.float32))
+    np.asarray(s)
 
 
 def _checksum(out) -> jax.Array:
@@ -39,13 +84,7 @@ def _perturb(x: jax.Array, i: jax.Array) -> jax.Array:
     return x + (i % 2).astype(x.dtype)
 
 
-def scan_time(fn: Callable, x, extra: Sequence = (), iters: int = 64,
-              repeats: int = 3) -> float:
-    """Seconds per application of ``fn(x, *extra)``: the op runs ``iters``
-    times inside one jitted ``lax.scan`` (input perturbed per step — the
-    anti-hoisting role the reference's L2 flush plays) and syncs once via a
-    scalar checksum, amortizing the ~100 ms device-link round-trip."""
-
+def _make_scan(fn, iters):
     @jax.jit
     def run(x, *extra):
         def body(acc, i):
@@ -56,25 +95,65 @@ def scan_time(fn: Callable, x, extra: Sequence = (), iters: int = 64,
                           jnp.arange(iters, dtype=jnp.int32))
         return acc
 
-    np.asarray(run(x, *extra))  # compile + warm
-    best = np.inf
-    for _ in range(repeats):
+    return run
+
+
+def scan_stats(fn: Callable, x, extra: Sequence = (), iters: int = 0,
+               repeats: int = 5) -> dict:
+    """Median/min/max seconds per application of ``fn(x, *extra)``, RTT
+    error subtracted. ``iters=0`` auto-sizes the scan so RTT/iters stays
+    under 2% of the op time (capped at 1024). The jitted scan is built
+    and warmed once per iters value; only the repeats are timed."""
+    rtt = link_rtt()
+
+    def timed(run, n):
         t0 = time.perf_counter()
         np.asarray(run(x, *extra))
-        best = min(best, (time.perf_counter() - t0) / iters)
-    return best
+        return (time.perf_counter() - t0) / n
+
+    if iters == 0:
+        probe_run = _make_scan(fn, 16)
+        np.asarray(probe_run(x, *extra))  # compile + warm
+        probe = max(timed(probe_run, 16) - rtt / 16, 1e-6)
+        iters = int(min(1024, max(16, 50.0 * rtt / probe)))
+    run = _make_scan(fn, iters)
+    np.asarray(run(x, *extra))  # compile + warm once
+    times = sorted(timed(run, iters) - rtt / iters for _ in range(repeats))
+    return {
+        "median_s": float(np.median(times)),
+        "min_s": times[0],
+        "max_s": times[-1],
+        "iters": iters,
+        "repeats": repeats,
+    }
+
+
+def scan_time(fn: Callable, x, extra: Sequence = (), iters: int = 64,
+              repeats: int = 3) -> float:
+    """Median seconds per application of ``fn(x, *extra)`` (see
+    scan_stats). Kept as the scalar entry for the legacy bench surface
+    with the historical iters=64 default — the RTT subtraction makes
+    that accurate without the auto-probe's extra compile."""
+    return scan_stats(fn, x, extra, iters=iters, repeats=repeats)["median_s"]
+
+
+def wall_stats(fn: Callable, repeats: int = 3) -> dict:
+    """Wall-clock stats for host-driving functions (index builds, fits)
+    that cannot scan; first call (compile) excluded; fenced via a
+    dependent scalar transfer."""
+    fence(fn())
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fence(fn())
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return {"median_s": float(np.median(times)), "min_s": times[0],
+            "max_s": times[-1], "repeats": repeats}
 
 
 def wall_time(fn: Callable, repeats: int = 2) -> float:
-    """Wall-clock seconds for host-driving functions (index builds, fits)
-    that cannot scan; first call (compile) excluded."""
-    jax.block_until_ready(fn())
-    best = np.inf
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        best = min(best, time.perf_counter() - t0)
-    return best
+    return wall_stats(fn, repeats=repeats)["median_s"]
 
 
 def report(family: str, name: str, seconds: float, items: float = 0.0,

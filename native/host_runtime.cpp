@@ -258,6 +258,98 @@ int raft_select_k_host(const float* in, int64_t batch, int64_t len, int64_t k,
   return 0;
 }
 
-int raft_native_version() { return 1; }
+// ---------------------------------------------------------------------------
+// Dendrogram agglomeration over MST edges (ref: cluster/detail/
+// agglomerative.cuh build_dendrogram_host + extract_flattened_clusters).
+// The merge bookkeeping is inherently sequential union-find — O(E α(n))
+// over the n-1 MST edges, so native code makes the 1M-row walk ~10 ms
+// where the Python loop took minutes.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct UnionFind {
+  std::vector<int64_t> parent;
+  explicit UnionFind(int64_t n) : parent(n) {
+    for (int64_t i = 0; i < n; ++i) parent[i] = i;
+  }
+  int64_t find(int64_t a) {
+    int64_t root = a;
+    while (parent[root] != root) root = parent[root];
+    while (parent[a] != root) {
+      int64_t next = parent[a];
+      parent[a] = root;
+      a = next;
+    }
+    return root;
+  }
+};
+
+}  // namespace
+
+extern "C" int raft_dendrogram_host(
+    const int32_t* src, const int32_t* dst, const float* w, int64_t n_edges,
+    int64_t n, int64_t n_clusters, int64_t* children, double* distances,
+    int64_t* sizes, int32_t* labels, int64_t* n_merges_out) {
+  if (n <= 0 || n_clusters < 1 || n_clusters > n) return -1;
+  for (int64_t e = 0; e < n_edges; ++e) {  // reject OOB endpoints cleanly
+    if (src[e] < 0 || src[e] >= n || dst[e] < 0 || dst[e] >= n) return -2;
+  }
+  // Stable argsort of the edges by weight (scipy/agglomerative order).
+  std::vector<int64_t> order(n_edges);
+  for (int64_t i = 0; i < n_edges; ++i) order[i] = i;
+  std::stable_sort(order.begin(), order.end(),
+                   [&](int64_t a, int64_t b) { return w[a] < w[b]; });
+
+  // Pass 1: full dendrogram (leaves 0..n-1, internal nodes n..2n-2).
+  UnionFind uf(2 * n - 1);
+  std::vector<int64_t> size(2 * n - 1, 1);
+  int64_t merge = 0;
+  for (int64_t e : order) {
+    if (merge == n - 1) break;
+    int64_t ra = uf.find(src[e]);
+    int64_t rb = uf.find(dst[e]);
+    if (ra == rb) continue;
+    int64_t node = n + merge;
+    children[2 * merge] = ra;
+    children[2 * merge + 1] = rb;
+    distances[merge] = w[e];
+    int64_t sz = size[ra] + size[rb];
+    sizes[merge] = sz;
+    uf.parent[ra] = node;
+    uf.parent[rb] = node;
+    size[node] = sz;
+    ++merge;
+  }
+  *n_merges_out = merge;
+
+  // Pass 2: flat labels — apply only the first n - n_clusters merges.
+  UnionFind flat(n);
+  int64_t left = std::max<int64_t>(
+      0, std::min<int64_t>(merge, n - n_clusters));
+  for (int64_t e : order) {
+    if (left == 0) break;
+    int64_t ra = flat.find(src[e]);
+    int64_t rb = flat.find(dst[e]);
+    if (ra == rb) continue;
+    flat.parent[ra] = rb;
+    --left;
+  }
+  // Relabel roots to consecutive ids in ascending-root order (np.unique
+  // return_inverse semantics, matching the Python fallback).
+  std::vector<int64_t> roots(n);
+  for (int64_t i = 0; i < n; ++i) roots[i] = flat.find(i);
+  std::vector<int64_t> uniq(roots);
+  std::sort(uniq.begin(), uniq.end());
+  uniq.erase(std::unique(uniq.begin(), uniq.end()), uniq.end());
+  for (int64_t i = 0; i < n; ++i) {
+    labels[i] = (int32_t)(std::lower_bound(uniq.begin(), uniq.end(),
+                                           roots[i]) -
+                          uniq.begin());
+  }
+  return 0;
+}
+
+extern "C" int raft_native_version() { return 1; }
 
 }  // extern "C"

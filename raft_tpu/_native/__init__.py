@@ -46,9 +46,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         p_f32, p_i64, i64, i64, i64, ctypes.c_int, p_i64, p_f32, p_i64]
     lib.raft_select_k_host.argtypes = [
         p_f32, i64, i64, i64, ctypes.c_int, p_f32, p_i64]
+    p_f64 = ctypes.POINTER(ctypes.c_double)
+    lib.raft_dendrogram_host.argtypes = [
+        p_i32, p_i32, p_f32, i64, i64, i64, p_i64, p_f64, p_i64, p_i32,
+        p_i64]
     for fn in (lib.raft_read_fvecs, lib.raft_read_bvecs, lib.raft_read_ivecs,
                lib.raft_write_fvecs, lib.raft_refine_host,
-               lib.raft_knn_merge_parts, lib.raft_select_k_host):
+               lib.raft_knn_merge_parts, lib.raft_select_k_host,
+               lib.raft_dendrogram_host):
         fn.restype = ctypes.c_int
     return lib
 
@@ -212,6 +217,36 @@ def select_k_host(x: np.ndarray, k: int, select_min: bool = True):
     if rc != 0:
         raise ValueError(f"select_k_host failed (rc={rc})")
     return out_v, out_i
+
+
+def dendrogram_host(src: np.ndarray, dst: np.ndarray, w: np.ndarray,
+                    n: int, n_clusters: int):
+    """Union-find agglomeration over weight-sorted MST edges (ref:
+    cluster/detail/agglomerative.cuh). Returns ``(labels, children,
+    distances, sizes)`` truncated to the performed merges, or None when
+    the native library is unavailable (caller falls back to Python)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    w = np.ascontiguousarray(w, np.float32)
+    m = max(n - 1, 0)
+    children = np.zeros((m, 2), np.int64)
+    distances = np.zeros(m, np.float64)
+    sizes = np.zeros(m, np.int64)
+    labels = np.zeros(n, np.int32)
+    merges = ctypes.c_int64()
+    rc = lib.raft_dendrogram_host(
+        _ptr(src, ctypes.c_int32), _ptr(dst, ctypes.c_int32),
+        _ptr(w, ctypes.c_float), src.shape[0], n, n_clusters,
+        _ptr(children, ctypes.c_int64),
+        _ptr(distances, ctypes.c_double), _ptr(sizes, ctypes.c_int64),
+        _ptr(labels, ctypes.c_int32), ctypes.byref(merges))
+    if rc != 0:
+        raise ValueError(f"dendrogram_host failed (rc={rc})")
+    k = merges.value
+    return labels, children[:k], distances[:k], sizes[:k]
 
 
 # --- NumPy fallbacks (used when the toolchain is unavailable) ---------------

@@ -171,11 +171,12 @@ def _stream_select_min(values, k: int, interpret: bool = False):
     n/64 candidates at memory-floor HBM traffic), one small ``top_k``
     ranks the candidates, and an exactness audit catches the only way
     compression can lose an element: a chunk whose 8th-smallest still
-    beats the candidate k-th. Such rows fall back to a full ``top_k``
-    inside ``lax.cond`` (both branches compiled, one executed — the
-    radix kernel's extra passes, paid only on pathological skew such as
-    sorted input; on typical data the audit passes and the fast path is
-    final). k ≤ 256 (the reference warpsort cap, select_warpsort.cuh:100).
+    beats the candidate k-th. Any audit hit falls the WHOLE batch back
+    to a full ``top_k`` inside ``lax.cond`` (both branches compiled, one
+    executed) — a single pathological row (sorted, constant, NaN) costs
+    the batch one extra full top_k; on typical data the audit passes and
+    the fast path is final. k ≤ 256 (the reference warpsort cap,
+    select_warpsort.cuh:100).
     """
     from raft_tpu.util.pow2 import round_up_safe
 
@@ -255,7 +256,9 @@ def _stream_supported(batch: int, n: int, k: int, dtype) -> bool:
     Needs n/64 candidates ≥ 2k for audit headroom."""
     return (jax.default_backend() == "tpu" and 64 <= k <= 256
             and n >= 65536 and n >= 128 * k and batch >= 8
-            and jnp.issubdtype(jnp.dtype(dtype), jnp.floating))
+            and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                     jnp.dtype(jnp.bfloat16),
+                                     jnp.dtype(jnp.float16)))
 
 
 @traced
@@ -302,9 +305,11 @@ def select_k(
 
             expects(k <= 256,
                     "kStream supports k <= 256 (the warpsort cap)")
-            expects(jnp.issubdtype(v.dtype, jnp.floating),
-                    "kStream requires floating-point values "
-                    "(integer keys are not exact in its f32 pipeline)")
+            expects(v.dtype in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.float16)),
+                    "kStream requires f32/bf16/f16 values (integer and "
+                    "f64 keys are not exact in its f32 pipeline)")
             expects(round_up_safe(n, _BT) // _SUB * _M >= k,
                     f"kStream needs len/64 candidates >= k (len={n}, "
                     f"k={k}); use kTopK")

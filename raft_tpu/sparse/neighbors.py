@@ -52,7 +52,26 @@ def knn_graph(
     n = X.shape[0]
     metric = resolve_metric(metric)
     # k+1 then drop self (the nearest neighbor of a point is itself).
-    d, i = tiled_brute_force_knn(X, X, min(k + 1, n), metric=metric)
+    kk = min(k + 1, n)
+    # Chunk the query axis: one fused kernel over n x n at n = 10^6 is a
+    # multi-GB, multi-minute single launch (observed to take down the
+    # worker); 128K-query chunks keep each dispatch bounded. The ragged
+    # tail is padded to the chunk shape so every chunk shares one
+    # compilation.
+    chunk = 131072
+    if n <= chunk:
+        d, i = tiled_brute_force_knn(X, X, kk, metric=metric)
+    else:
+        pad = (-n) % chunk
+        Q = jnp.concatenate([X, X[:pad]]) if pad else X
+        dps, ips = [], []
+        for s in range(0, Q.shape[0], chunk):
+            dp, ip = tiled_brute_force_knn(Q[s:s + chunk], X, kk,
+                                           metric=metric)
+            dps.append(dp)
+            ips.append(ip)
+        d = jnp.concatenate(dps)[:n]
+        i = jnp.concatenate(ips)[:n]
     rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), i.shape[1])
     cols = i.reshape(-1)
     vals = d.reshape(-1)

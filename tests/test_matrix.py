@@ -141,7 +141,7 @@ def test_stream_explicit_validation(rng):
     from raft_tpu.core.error import RaftError
 
     xi = rng.integers(-100, 100, (8, 70000)).astype(np.int32)
-    with pytest.raises(RaftError, match="floating"):
+    with pytest.raises(RaftError, match="not exact"):
         select_k(xi, 64, method=SelectMethod.kStream)
     xf = rng.standard_normal((8, 1000)).astype(np.float32)
     with pytest.raises(RaftError, match="candidates"):

@@ -111,3 +111,37 @@ class TestSelectKHost:
         x = rng.normal(size=(2, 5)).astype(np.float32)
         with pytest.raises(ValueError):
             _native.select_k_host(x, 6)
+
+
+def test_dendrogram_host_matches_python(rng):
+    """Native union-find agglomeration agrees with the Python fallback
+    (labels, children, distances, sizes) on a random MST-like edge set."""
+    import importlib
+    import sys
+
+    from raft_tpu import _native
+
+    importlib.import_module("raft_tpu.cluster.single_linkage")
+    sl = sys.modules["raft_tpu.cluster.single_linkage"]
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    n = 500
+    # random spanning tree: connect node i to a random earlier node
+    src = np.arange(1, n, dtype=np.int32)
+    dst = rng.integers(0, np.maximum(src, 1)).astype(np.int32)
+    w = rng.random(n - 1).astype(np.float32)
+    got = _native.dendrogram_host(src, dst, w, n, 7)
+    assert got is not None
+
+    # force the Python fallback by nulling the lib handle
+    real = _native.get_lib
+    try:
+        _native.get_lib = lambda: None
+        want = sl._dendrogram(src, dst, w, n, 7)
+    finally:
+        _native.get_lib = real
+    np.testing.assert_array_equal(got[0], want[0])      # labels
+    np.testing.assert_array_equal(got[1], want[1])      # children
+    np.testing.assert_allclose(got[2], want[2])         # distances
+    np.testing.assert_array_equal(got[3], want[3])      # sizes

@@ -190,6 +190,34 @@ class TestBlockedSparseEngine:
                                    cdist(a, b, "cityblock"),
                                    rtol=1e-3, atol=1e-3)
 
+    def test_skewed_density_groups(self, rng, monkeypatch):
+        """One dense row block must not inflate every block's padding:
+        skewed inputs split into nnz groups (multiple compiled caps) and
+        stay exact for both pairwise and kNN."""
+        monkeypatch.setattr(distance, "_DENSE_BYTES", 0)
+        monkeypatch.setattr(distance, "_STAGE_TILE_BYTES", 64 * 4 * 40)
+        d, m = 400, 96
+        a = np.zeros((m, d), np.float32)
+        for i in range(m):
+            nnz = 160 if i < 8 else 4   # first block dense, rest sparse
+            cols = rng.choice(d, size=nnz, replace=False)
+            a[i, cols] = rng.normal(size=nnz).astype(np.float32)
+        ca = csr_from_dense(a)
+        b = distance._pick_block(m, d, False)
+        _, nnzb = distance._block_pad_csr(ca, b)
+        groups = distance._nnz_groups(nnzb)
+        assert len(groups) > 1, (b, nnzb)
+        got = distance.pairwise_distance(ca, ca, metric="sqeuclidean")
+        want = ((a[:, None, :] - a[None]) ** 2).sum(-1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-3,
+                                   atol=1e-3)
+        dist_b, idx_b = distance.knn_blocked(ca, ca, 5)
+        truth = np.argsort(want, axis=1)[:, :5]
+        found = np.asarray(idx_b)
+        hits = sum(len(np.intersect1d(found[i], truth[i]))
+                   for i in range(m))
+        assert hits / truth.size > 0.99
+
     def test_blocked_knn_matches_dense(self, rng, monkeypatch):
         monkeypatch.setattr(distance, "_DENSE_BYTES", 0)
         a = _rand_sparse(rng, m=90, n=40)
