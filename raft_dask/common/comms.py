@@ -70,9 +70,11 @@ class Comms:
         from raft_tpu.comms.comms import build_comms, inject_comms_on_handle
         from raft_tpu.core.resources import DeviceResources
 
-        if self._coord is not None and jax.process_count() == 1:
+        if self._coord is not None and not jax.distributed.is_initialized():
             # Multi-host bootstrap over DCN — the analog of the NCCL
-            # unique-id broadcast (comms.py:135,355).
+            # unique-id broadcast (comms.py:135,355). The probe must not
+            # touch the backend (jax.process_count() would initialize XLA
+            # and make the distributed init impossible).
             jax.distributed.initialize(
                 coordinator_address=self._coord,
                 num_processes=self._nprocs,
