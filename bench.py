@@ -72,16 +72,21 @@ def _family():
 
     rng = np.random.default_rng(0)
 
-    # -- pairwise cosine, round-1 shape (2048^2 x 128) + a compute-bound
-    # shape (8192^2 x 256) with the MFU column.
-    for (m, d, name, r1) in ((2048, 128, "pairwise_cosine_2048_gpairs",
-                              _R1["pairwise_cosine_2048_gpairs"]),
-                             (8192, 256, "pairwise_cosine_8192x256_gpairs",
-                              None)):
+    # -- pairwise cosine: round-1 shape (2048^2 x 128), a compute-bound
+    # shape (8192^2 x 256), and the same at bf16 MXU precision (the knob
+    # users flip when ~1e-3 relative error is acceptable) — the MFU
+    # evidence VERDICT r2 weak #2 asked for.
+    for (m, d, prec, name, r1) in (
+            (2048, 128, "highest", "pairwise_cosine_2048_gpairs",
+             _R1["pairwise_cosine_2048_gpairs"]),
+            (8192, 256, "highest", "pairwise_cosine_8192x256_gpairs", None),
+            (8192, 256, "default", "pairwise_cosine_8192x256_bf16_gpairs",
+             None)):
         a = jnp.asarray(rng.normal(size=(m, d)).astype(np.float32))
         b = jnp.asarray(rng.normal(size=(m, d)).astype(np.float32))
         st = scan_stats(
-            lambda x, y: pairwise(x, y, metric=DistanceType.CosineExpanded),
+            lambda x, y, p=prec: pairwise(
+                x, y, metric=DistanceType.CosineExpanded, precision=p),
             a, (b,))
         s = st["median_s"]
         v = m * m / s / 1e9

@@ -85,9 +85,11 @@ class SearchParams:
     best-half-rank contention (one jitted scalar device read), bounded at
     8× the mean probe load: below the bound only rank ≥ n_probes/2
     probes of contended lists ever drop; when hot-list skew pushes the
-    drop-free capacity past the bound, auto caps there (deep-rank probes
-    of the hot lists may then drop — measured recall-neutral at 1M while
-    4-5× faster than drop-free sizing). Auto falls back to "scan" when
+    drop-free capacity past the bound, auto caps there — floored at the
+    measured rank-0 contention, so a query's single best probe never
+    drops — and deeper-rank probes of the hot lists may then drop
+    (measured recall-neutral at 1M while 4-5× faster than drop-free
+    sizing). Auto falls back to "scan" when
     the capacity would exceed the bucket memory budget, and picks
     bucketed on TPU when the probe load q·n_probes/n_lists is high
     enough to fill tiles.
@@ -466,13 +468,19 @@ def _auto_cap_cache(index) -> dict:
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _front_rank_contention(probe_ids, n_lists: int):
-    """Max per-list count of (query, probe) pairs whose centroid rank is in
-    the best half of each query's probe list. A bucket capacity ≥ this
-    value guarantees the bucketed engine only ever drops rank ≥ n_probes/2
-    probes of contended lists (see SearchParams)."""
+    """Per-list contention of (query, probe) pairs: returns
+    ``(best_half_max, rank0_max)`` — the max count over lists of pairs
+    whose centroid rank is in each query's best half, and of rank-0
+    (best-probe) pairs alone. A bucket capacity ≥ best_half_max makes the
+    bucketed engine drop only rank ≥ n_probes/2 probes; ≥ rank0_max is
+    the hard floor below which a query could lose its single best probe
+    (see SearchParams)."""
     half = max(1, probe_ids.shape[1] - probe_ids.shape[1] // 2)
     front = probe_ids[:, :half]
-    return jnp.max(jnp.bincount(front.reshape(-1), length=n_lists))
+    return jnp.stack([
+        jnp.max(jnp.bincount(front.reshape(-1), length=n_lists)),
+        jnp.max(jnp.bincount(probe_ids[:, 0], length=n_lists)),
+    ])
 
 
 def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
@@ -485,12 +493,14 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
 
     Auto-sized bucket capacity is measured from the probe map (one jitted
     scalar device→host read): the capacity covers every pair whose centroid
-    rank is in the query's best half, so only farthest-rank probes of
-    contended lists can drop — never a query's best probes. If that
-    capacity would blow the bucket-table memory budget (pathological skew),
-    auto falls back to the exact scan engine instead of truncating hot
-    lists. An explicit ``bucket_cap`` skips the measurement and accepts
-    the documented drop behavior at that capacity.
+    rank is in the query's best half — bounded at 8× the mean probe load
+    under hot-list skew (floored at the rank-0 contention, so a query's
+    single best probe never drops; between the floor and the best-half
+    need, deeper-rank probes of hot lists may drop). If even the bounded
+    capacity would blow the bucket-table memory budget, auto falls back
+    to the exact scan engine instead of truncating hot lists. An explicit
+    ``bucket_cap`` skips the measurement and accepts the documented drop
+    behavior at that capacity.
 
     ``cap_cache`` (a dict owned by the Index) memoizes the measured
     capacity per (n_queries, n_probes) so a steady-state query loop pays
@@ -520,22 +530,25 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
         key = (n_queries, n_probes)
         if cap_cache is not None and key in cap_cache:
             return cap_cache[key]
-        front = int(_front_rank_contention(probe_ids, n_lists))
+        front, rank0 = (int(v) for v in
+                        np.asarray(_front_rank_contention(probe_ids,
+                                                          n_lists)))
         # Next power of two: batches with slightly different contention
         # land on the same compiled bucket shapes.
-        cap = 1 << (max(front, 4 * mean_load, 8) - 1).bit_length()
+        cap = next_pow2(max(front, 4 * mean_load, 8))
         # Skew bound: a drop-free capacity beyond 8x the mean probe load
         # means a few hot lists would dictate everyone's bucket width (a
         # heavily clustered query batch measured 4-5x slower than the
-        # tuned capacity at 1M for no recall gain). Cap there — beyond it
-        # only deep-rank probes of hot lists drop, the documented bucket
-        # overflow policy.
-        bound = 1 << (8 * mean_load - 1).bit_length()
+        # tuned capacity at 1M for no recall gain). Cap there — but never
+        # below the rank-0 contention: a query's single best probe must
+        # never drop, whatever the skew. Beyond the bound, deeper-rank
+        # probes of hot lists may drop (the documented overflow policy).
+        bound = max(next_pow2(8 * mean_load), next_pow2(max(rank0, 1)))
         if cap > bound:
             logger.debug(
-                "auto bucket cap %d exceeds 8x mean-load bound %d "
-                "(hot-list skew) - capping; deep-rank probes of contended "
-                "lists may drop", cap, bound)
+                "auto bucket cap %d exceeds skew bound %d (8x mean load, "
+                "floored at rank-0 contention %d) - capping; deep-rank "
+                "probes of contended lists may drop", cap, bound, rank0)
             cap = bound
         cap = min(n_queries, cap)
         if cap_cache is not None:
@@ -670,7 +683,14 @@ def search(
     # (ref: select_clusters-analog in ivf_flat_search).
     probe_ids = _coarse_probe(Q, index.centers, n_probes, inner_is_l2)
 
-    dataf = _as_float(index.data)
+    if index.data.dtype in (jnp.dtype(jnp.uint8), jnp.dtype(jnp.int8)):
+        # 8-bit integer storage (the reference's ivf_flat<int8/uint8>
+        # instantiations, ivf_flat_search.cuh:456): 8-bit values are
+        # exact in bf16, so the scoring rides the bf16 MXU path at half
+        # the f32 staging bandwidth; norms accumulate in f32 below.
+        dataf = index.data.astype(jnp.bfloat16)
+    else:
+        dataf = _as_float(index.data)
 
     engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
                                  index.n_lists, k, params.bucket_cap,
@@ -682,7 +702,14 @@ def search(
             k, inner_is_l2, sqrt, cap_q,
             jax.default_backend() != "tpu")
 
-    norms = jnp.sum(dataf * dataf, axis=2) if inner_is_l2 else None
+    if inner_is_l2:
+        # f32-accumulated norms without materializing a full f32 copy of
+        # (possibly bf16-cast 8-bit) storage: the upcast fuses into the
+        # reduction.
+        norms = jnp.einsum("lcd,lcd->lc", dataf, dataf,
+                           preferred_element_type=jnp.float32)
+    else:
+        norms = None
     # The scan engine's per-probe gather is (q_chunk, cap, dim) — chunk the
     # query axis so the workspace stays bounded at large cap (at cap=2048,
     # d=128, 1000 unchunked queries would stage ~1 GB per probe step).

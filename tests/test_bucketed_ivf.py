@@ -227,3 +227,22 @@ def test_measured_cap_cached_per_index(rng, monkeypatch):
     impl.extend(idx, db[:8], np.arange(8, dtype=np.int32))
     impl.search(sp, idx, Q, 5)
     assert len(calls) == 3
+
+
+def test_skew_bound_never_drops_best_probe(rng):
+    """Extreme skew: every query's rank-0 probe is the same list. The
+    8x-mean-load bound must floor at the rank-0 contention, so each
+    query's nearest-list candidates survive and its true NN is found."""
+    from raft_tpu.neighbors import ivf_flat as impl
+
+    # One tight hot cluster + scattered others.
+    hot = rng.normal(size=(400, 8)).astype(np.float32) * 0.05
+    rest = rng.normal(size=(1600, 8)).astype(np.float32) + 8.0
+    db = np.concatenate([hot, rest])
+    idx = impl.build(impl.IndexParams(n_lists=16, kmeans_n_iters=5), db)
+    # All queries sit in the hot cluster -> rank-0 contention = n_queries.
+    Q = hot[:256] + rng.normal(size=(256, 8)).astype(np.float32) * 0.01
+    d, i = impl.search(impl.SearchParams(n_probes=4), idx, Q, 1)
+    dn = ((Q[:, None, :] - db[None]) ** 2).sum(-1)
+    truth = dn.argmin(1)
+    assert np.mean(np.asarray(i)[:, 0] == truth) > 0.99

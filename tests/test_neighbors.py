@@ -282,3 +282,40 @@ def test_ivf_flat_uint8_native_storage(rng, tmp_path):
     path = str(tmp_path / "idx_u8.npz")
     ivf_flat.save(path, idx)
     assert ivf_flat.load(path).data.dtype == np.uint8
+
+
+class TestIvfFlatQuantized:
+    """8-bit storage parity (ref: the reference's ivf_flat<int8/uint8>
+    instantiations and their bench coverage, cpp/bench/neighbors/knn.cuh).
+    8-bit values are exact in bf16, so quantized indexes must agree with
+    the f32 index on integer-valued data."""
+
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+    def test_quantized_matches_f32(self, rng, dtype):
+        lo, hi = (0, 256) if dtype == np.uint8 else (-128, 128)
+        db = rng.integers(lo, hi, size=(4000, 32)).astype(dtype)
+        q = db[:25].astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        idx8 = ivf_flat.build(params, db)
+        assert idx8.data.dtype == dtype        # stored quantized
+        idxf = ivf_flat.build(params, db.astype(np.float32))
+        for engine in ("scan", "bucketed"):
+            sp = ivf_flat.SearchParams(n_probes=16, engine=engine,
+                                       bucket_cap=64)
+            d8, i8 = ivf_flat.search(sp, idx8, q, 5)
+            df, if_ = ivf_flat.search(sp, idxf, q, 5)
+            np.testing.assert_array_equal(np.asarray(i8), np.asarray(if_))
+            np.testing.assert_allclose(np.asarray(d8), np.asarray(df),
+                                       rtol=1e-5, atol=1e-2)
+
+    def test_quantized_extend_and_roundtrip(self, rng, tmp_path):
+        db = rng.integers(0, 256, size=(2000, 16)).astype(np.uint8)
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3), db)
+        extra = rng.integers(0, 256, size=(100, 16)).astype(np.uint8)
+        idx = ivf_flat.extend(idx, extra)
+        assert idx.data.dtype == np.uint8 and idx.size == 2100
+        f = str(tmp_path / "u8idx")
+        ivf_flat.save(f, idx)
+        loaded = ivf_flat.load(f)
+        assert loaded.data.dtype == np.uint8
