@@ -573,7 +573,18 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
         expects(not tracing,
                 "engine='bucketed' with bucket_cap=0 measures the probe "
                 "map and cannot run under jit; pass an explicit bucket_cap")
-        cap_q = min(measured_cap(), cap_clamp)
+        cap_q = measured_cap()
+        if cap_q > cap_clamp:
+            # The explicit-bucketed user insists on this engine; the
+            # memory clamp can then cut below the rank-0 floor the
+            # measured sizing guarantees — say so (auto falls back to
+            # scan instead).
+            logger.warning(
+                "bucketed capacity clamped %d -> %d by the bucket-table "
+                "memory budget; under heavy skew queries may lose "
+                "best-rank probes (use engine='auto' or 'scan' for the "
+                "drop-safe behavior)", cap_q, cap_clamp)
+            cap_q = cap_clamp
     # Debug log at the dispatch decision, like the reference's
     # RAFT_LOG_DEBUG at perf-relevant branches (SURVEY.md §5).
     logger.debug(
@@ -606,10 +617,40 @@ def _bucketed_probe_scan(
 
     q, d = queries.shape
     n_lists, cap, _ = data.shape
-    p = probe_ids.shape[1]
 
-    # --- invert: (query → lists) to (list → queries), rank-major so that
-    # bucket overflow drops the farthest-centroid probes first.
+    bucket, route = _invert_probe_map(probe_ids, n_lists, bucket_cap)
+
+    # --- batched per-list kNN on the MXU
+    qsel = jnp.maximum(bucket, 0)
+    Qb = queries[qsel]                                         # (L, cap_q, d)
+    invalid = jnp.arange(cap, dtype=jnp.int32)[None, :] >= list_sizes[:, None]
+    bd_, bi_ = fused_batch_knn(
+        Qb, data, invalid, k,
+        metric="l2" if inner_is_l2 else "ip",
+        bf16=data.dtype == jnp.bfloat16, interpret=interpret)
+    gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
+                 jnp.maximum(bi_, 0)]                          # (L, cap_q, kk)
+    gi = jnp.where(bi_ < 0, -1, gi)
+
+    worst = jnp.inf if inner_is_l2 else -jnp.inf
+    cd, ci = _route_candidates(bd_, gi, route, q, probe_ids.shape[1],
+                               bucket_cap, worst)
+    # indices= payload: select_k then maps its k>n padding slots to the -1
+    # sentinel instead of emitting out-of-range positions.
+    best_d, best_i = select_k(cd, k, select_min=inner_is_l2, indices=ci)
+    if inner_is_l2 and sqrt:
+        best_d = jnp.sqrt(best_d)
+    return best_d, best_i
+
+
+def _invert_probe_map(probe_ids, n_lists: int, bucket_cap: int):
+    """Invert (query → probed lists) into per-list query buckets,
+    rank-major so bucket overflow drops the farthest-centroid probes
+    first (the calc_chunk_indices re-tiling — see _bucketed_probe_scan).
+    Returns ``(bucket (n_lists, cap_q), route)`` where ``route`` carries
+    what :func:`_route_candidates` needs to send per-pair results back to
+    their queries."""
+    q, p = probe_ids.shape
     flat_lists = probe_ids.T.reshape(-1)                       # (p·q,)
     flat_query = jnp.tile(jnp.arange(q, dtype=jnp.int32), p)
     order = jnp.argsort(flat_lists, stable=True)
@@ -624,22 +665,15 @@ def _bucketed_probe_scan(
     bucket = (jnp.full((n_lists * bucket_cap,), -1, jnp.int32)
               .at[slot].set(sorted_query, mode="drop")
               .reshape(n_lists, bucket_cap))
+    return bucket, (sorted_lists, pos, keep, order)
 
-    # --- batched per-list kNN on the MXU
-    qsel = jnp.maximum(bucket, 0)
-    Qb = queries[qsel]                                         # (L, cap_q, d)
-    invalid = jnp.arange(cap, dtype=jnp.int32)[None, :] >= list_sizes[:, None]
-    bd_, bi_ = fused_batch_knn(
-        Qb, data, invalid, k,
-        metric="l2" if inner_is_l2 else "ip",
-        bf16=data.dtype == jnp.bfloat16, interpret=interpret)
-    kk = bd_.shape[2]                                          # min(k, cap)
-    gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
-                 jnp.maximum(bi_, 0)]                          # (L, cap_q, kk)
-    worst = jnp.inf if inner_is_l2 else -jnp.inf
-    gi = jnp.where(bi_ < 0, -1, gi)
 
-    # --- route each pair's candidates back to its query
+def _route_candidates(bd_, gi, route, q: int, p: int, bucket_cap: int,
+                      worst):
+    """Send each (list, slot) pair's top-kk candidates back to its query:
+    (q, p·kk) distance/id candidate rows ready for the final select_k."""
+    sorted_lists, pos, keep, order = route
+    kk = bd_.shape[2]
     ppos = jnp.minimum(pos, bucket_cap - 1)
     cd = bd_[sorted_lists, ppos]                               # (p·q, kk)
     ci = gi[sorted_lists, ppos]
@@ -648,13 +682,7 @@ def _bucketed_probe_scan(
     inv = jnp.argsort(order)
     cd = cd[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
     ci = ci[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
-
-    # indices= payload: select_k then maps its k>n padding slots to the -1
-    # sentinel instead of emitting out-of-range positions.
-    best_d, best_i = select_k(cd, k, select_min=inner_is_l2, indices=ci)
-    if inner_is_l2 and sqrt:
-        best_d = jnp.sqrt(best_d)
-    return best_d, best_i
+    return cd, ci
 
 
 @traced

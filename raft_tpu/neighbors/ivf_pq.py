@@ -54,8 +54,10 @@ from raft_tpu.neighbors.ivf_flat import (
     _auto_cap_cache,
     _bucketed_probe_scan,
     _chunked_over_queries,
+    _invert_probe_map,
     _pack_lists,
     _pick_engine,
+    _route_candidates,
 )
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv, next_pow2
@@ -256,26 +258,9 @@ class Index:
             J = self.pq_dim
             B, L = self.pq_book_size, self.pq_len
             per_cluster = self.codebook_kind == CodebookGen.PER_CLUSTER
-            # Flat 1-D gather with a (rows, J·L = rot_dim) output: a naive
-            # per-subspace take_along_axis emits (…, L) arrays whose tiny
-            # trailing dim the TPU layout pads to 128 lanes — a 64×
-            # allocation blowup at pq_len=2 (observed 64 GiB at SIFT-1M).
             flat_books = self.pq_centers.reshape(-1)
-            lp = jnp.arange(L, dtype=jnp.int32)
-            jbase = (jnp.arange(J, dtype=jnp.int32) * B * L)[None, :, None]
             centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
                                      precision=lax.Precision.HIGHEST)
-
-            def decode_lists(args):
-                # per-subspace books: one shared flat book table
-                codes_c, crot_c = args          # (lc, cap, nbytes), (lc, rot)
-                lc = codes_c.shape[0]
-                codes2 = unpack_codes(codes_c, J, self.pq_bits).reshape(
-                    lc * cap, J)
-                idx = jbase + codes2[:, :, None] * L + lp[None, None, :]
-                cw = flat_books[idx.reshape(lc * cap, J * L)]
-                cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
-                return cw.astype(jnp.bfloat16)
 
             chunk = max(1, min(n_lists, (1 << 25) // max(cap, 1)))
             if n_lists % chunk:
@@ -286,36 +271,130 @@ class Index:
             if per_cluster:
                 # each chunk needs its own books — gather flat per chunk
                 books_c = self.pq_centers.reshape(nc, chunk * B * L)
-
-                def decode_pc(args):
-                    codes_c, crot_c, fb = args
-                    lc = codes_c.shape[0]
-                    codes2 = unpack_codes(codes_c, J, self.pq_bits).reshape(
-                        lc * cap, J)
-                    base = jnp.repeat(
-                        jnp.arange(lc, dtype=jnp.int32) * (B * L), cap
-                    )[:, None, None]
-                    idx = base + codes2[:, :, None] * L + lp[None, None, :]
-                    cw = fb[idx.reshape(lc * cap, J * L)]
-                    cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
-                    return cw.astype(jnp.bfloat16)
-
-                recon = lax.map(decode_pc, (
-                    self.pq_codes.reshape(nc, chunk, cap, -1),
-                    centers_rot.reshape(nc, chunk, -1),
-                    books_c,
-                )).reshape(n_lists, cap, J * L)
+                recon = lax.map(
+                    lambda args: _decode_lists_block(
+                        args[0], args[1], args[2], J, B, L, self.pq_bits,
+                        True),
+                    (self.pq_codes.reshape(nc, chunk, cap, -1),
+                     centers_rot.reshape(nc, chunk, -1), books_c),
+                ).reshape(n_lists, cap, J * L)
             else:
-                recon = lax.map(decode_lists, (
-                    self.pq_codes.reshape(nc, chunk, cap, -1),
-                    centers_rot.reshape(nc, chunk, -1),
-                )).reshape(n_lists, cap, J * L)
+                recon = lax.map(
+                    lambda args: _decode_lists_block(
+                        args[0], args[1], flat_books, J, B, L,
+                        self.pq_bits, False),
+                    (self.pq_codes.reshape(nc, chunk, cap, -1),
+                     centers_rot.reshape(nc, chunk, -1)),
+                ).reshape(n_lists, cap, J * L)
             if isinstance(recon, jax.core.Tracer):
                 # Called under jit: recompute per trace — never persist a
                 # tracer on the index (it would poison later eager calls).
                 return recon
             object.__setattr__(self, "_recon", recon)
         return self._recon
+
+
+def _decode_lists_block(codes_c, crot_c, books_flat, J: int, B: int,
+                        L: int, pq_bits: int, per_cluster: bool):
+    """Decode a block of lists' packed codes to absolute bf16
+    reconstructions — the single definition of the flat-gather codeword
+    lookup (a naive per-subspace take_along_axis emits (…, L) arrays
+    whose tiny trailing dim the TPU layout pads to 128 lanes — a 64×
+    allocation blowup at pq_len=2, observed 64 GiB at SIFT-1M). Shared
+    by Index.reconstructed and the on-the-fly _bucketed_decode_scan.
+    ``books_flat`` is the global flat table (PER_SUBSPACE) or this
+    block's own flat books (PER_CLUSTER)."""
+    lc, cap = codes_c.shape[0], codes_c.shape[1]
+    lp = jnp.arange(L, dtype=jnp.int32)
+    codes2 = unpack_codes(codes_c, J, pq_bits).reshape(lc * cap, J)
+    if per_cluster:
+        base = jnp.repeat(jnp.arange(lc, dtype=jnp.int32) * (B * L),
+                          cap)[:, None, None]
+    else:
+        base = (jnp.arange(J, dtype=jnp.int32) * B * L)[None, :, None]
+    idx = base + codes2[:, :, None] * L + lp[None, None, :]
+    cw = books_flat[idx.reshape(lc * cap, J * L)]
+    cw = cw.reshape(lc, cap, J * L) + crot_c[:, None, :]
+    return cw.astype(jnp.bfloat16)
+
+
+@functools.partial(jax.jit,
+                   static_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _bucketed_decode_scan(
+    rotq, pq_codes, pq_centers, centers_rot, indices, list_sizes,
+    probe_ids, k: int, is_ip: bool, per_cluster: bool, bucket_cap: int,
+    pq_dim: int, pq_bits: int, interpret: bool = False,
+):
+    """Bucketed PQ search that decodes codes to bf16 tiles on the fly —
+    no persistent reconstruction cache, so PQ keeps its compression while
+    scoring rides the MXU (the in-kernel smem-LUT decode role of
+    compute_similarity_kernel, ivf_pq_search.cuh:611, re-tiled: invert
+    the probe map, then a lax.scan over list blocks decodes each block's
+    codes — the flat-gather formulation of Index.reconstructed — and
+    scores its query bucket with the fused batched kNN kernel). Peak
+    extra memory is one (block, cap, rot_dim) bf16 tile instead of the
+    full decompressed index.
+
+    This is the beyond-_RECON_AUTO_BYTES tier: each search pays a full
+    decode gather, so it runs ~2× the LUT scan's QPS (254 vs 139 at 1M
+    measured) but far below the recon-cached engine (12K) — use it when
+    the decompressed index genuinely cannot be resident."""
+    from raft_tpu.ops.fused_knn import fused_batch_knn
+
+    q, rot_dim = rotq.shape
+    n_lists, cap, _ = pq_codes.shape
+    J = pq_dim
+    B = 1 << pq_bits
+    L = rot_dim // J
+
+    bucket, route = _invert_probe_map(probe_ids, n_lists, bucket_cap)
+    qsel = jnp.maximum(bucket, 0)
+    Qb = rotq[qsel]                                   # (n_lists, cap_q, d)
+    invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+               >= list_sizes[:, None])
+
+    # Block size: bound the decoded bf16 tile (+ the unpack intermediate)
+    # to ~32 MB and keep it a divisor of n_lists for a clean scan.
+    block = max(1, min(n_lists, (1 << 24) // max(cap * rot_dim, 1)))
+    block = 1 << (block.bit_length() - 1)
+    while n_lists % block and block > 1:
+        block //= 2
+    nb = n_lists // block
+    flat_books = pq_centers.reshape(-1)
+    if per_cluster:
+        books_blk = pq_centers.reshape(nb, block * B * L)
+
+    def body(_, blk):
+        if per_cluster:
+            codes_b, crot_b, Qb_b, inv_b, fb = blk
+        else:
+            codes_b, crot_b, Qb_b, inv_b = blk
+            fb = flat_books
+        recon = _decode_lists_block(codes_b, crot_b, fb, J, B, L, pq_bits,
+                                    per_cluster)
+        bd_, bi_ = fused_batch_knn(Qb_b, recon, inv_b, k,
+                                   metric="ip" if is_ip else "l2",
+                                   bf16=True, interpret=interpret)
+        return None, (bd_, bi_)
+
+    xs = (pq_codes.reshape(nb, block, cap, -1),
+          centers_rot.reshape(nb, block, rot_dim),
+          Qb.reshape(nb, block, bucket_cap, rot_dim),
+          invalid.reshape(nb, block, cap))
+    if per_cluster:
+        xs = xs + (books_blk,)
+    _, (bd_, bi_) = lax.scan(body, None, xs)
+    kk = bd_.shape[3]
+    bd_ = bd_.reshape(n_lists, bucket_cap, kk)
+    bi_ = bi_.reshape(n_lists, bucket_cap, kk)
+    gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
+                 jnp.maximum(bi_, 0)]
+    gi = jnp.where(bi_ < 0, -1, gi)
+
+    worst = -jnp.inf if is_ip else jnp.inf
+    cd, ci = _route_candidates(bd_, gi, route, q, probe_ids.shape[1],
+                               bucket_cap, worst)
+    return select_k(cd, k, select_min=not is_ip, indices=ci)
 
 
 def _as_float(x) -> jax.Array:
@@ -799,19 +878,33 @@ def search(
     # engine="bucketed" overrides, documented on SearchParams).
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
-    recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
-        * index.rot_dim * 2
     engine, cap_q = _pick_engine(
         params.engine, Q.shape[0], n_probes, index.n_lists, k,
         params.bucket_cap, index.rot_dim, probe_ids,
-        allow_bucketed=default_dtypes and recon_bytes <= _RECON_AUTO_BYTES,
+        allow_bucketed=default_dtypes,
         cap_cache=_auto_cap_cache(index))
     if engine == "bucketed":
-        best_d, best_i = _bucketed_probe_scan(
-            rotq, index.reconstructed(),
-            index.indices, index.list_sizes, probe_ids,
-            k, not is_ip, False, cap_q,
-            jax.default_backend() != "tpu")
+        recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
+            * index.rot_dim * 2
+        interpret = jax.default_backend() != "tpu"
+        if index._recon is not None or recon_bytes <= _RECON_AUTO_BYTES:
+            # Small index or a user-precomputed cache: score against the
+            # resident bf16 reconstruction (fastest steady-state).
+            best_d, best_i = _bucketed_probe_scan(
+                rotq, index.reconstructed(),
+                index.indices, index.list_sizes, probe_ids,
+                k, not is_ip, False, cap_q, interpret)
+        else:
+            # Large index: decode blocks on the fly — PQ keeps its
+            # compression, no _RECON_AUTO_BYTES memory cliff.
+            centers_rot = jnp.matmul(index.centers, rot.T,
+                                     precision=lax.Precision.HIGHEST)
+            best_d, best_i = _bucketed_decode_scan(
+                rotq, index.pq_codes, index.pq_centers, centers_rot,
+                index.indices, index.list_sizes, probe_ids,
+                k, is_ip,
+                index.codebook_kind == CodebookGen.PER_CLUSTER,
+                cap_q, index.pq_dim, index.pq_bits, interpret)
         if index.metric == DistanceType.L2SqrtExpanded:
             best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
         return best_d, best_i

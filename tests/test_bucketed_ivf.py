@@ -230,19 +230,51 @@ def test_measured_cap_cached_per_index(rng, monkeypatch):
 
 
 def test_skew_bound_never_drops_best_probe(rng):
-    """Extreme skew: every query's rank-0 probe is the same list. The
-    8x-mean-load bound must floor at the rank-0 contention, so each
-    query's nearest-list candidates survive and its true NN is found."""
+    """Extreme skew: every query's rank-0 probe is the same list, with
+    n_lists > 8*n_probes so the 8x-mean-load bound (128) sits BELOW the
+    rank-0 contention (256) — the floor must win, so each query's
+    nearest-list candidates survive and its true NN is found. Explicit
+    engine='bucketed' with bucket_cap=0 forces the measured sizing on
+    every backend (auto would pick scan off-TPU)."""
     from raft_tpu.neighbors import ivf_flat as impl
 
-    # One tight hot cluster + scattered others.
+    # One tight hot cluster + scattered others across 64 lists.
     hot = rng.normal(size=(400, 8)).astype(np.float32) * 0.05
-    rest = rng.normal(size=(1600, 8)).astype(np.float32) + 8.0
+    rest = rng.normal(size=(6000, 8)).astype(np.float32) + 8.0
     db = np.concatenate([hot, rest])
-    idx = impl.build(impl.IndexParams(n_lists=16, kmeans_n_iters=5), db)
-    # All queries sit in the hot cluster -> rank-0 contention = n_queries.
+    idx = impl.build(impl.IndexParams(n_lists=64, kmeans_n_iters=5), db)
+    # All queries sit in the hot cluster -> rank-0 contention = n_queries
+    # = 256 > next_pow2(8 * (256*4//64)) = 128.
     Q = hot[:256] + rng.normal(size=(256, 8)).astype(np.float32) * 0.01
-    d, i = impl.search(impl.SearchParams(n_probes=4), idx, Q, 1)
+    sp = impl.SearchParams(n_probes=4, engine="bucketed", bucket_cap=0)
+    d, i = impl.search(sp, idx, Q, 1)
+    assert idx.__dict__["_auto_cap_cache"][(256, 4)] >= 256  # floor bound
     dn = ((Q[:, None, :] - db[None]) ** 2).sum(-1)
     truth = dn.argmin(1)
     assert np.mean(np.asarray(i)[:, 0] == truth) > 0.99
+
+
+@pytest.mark.parametrize("kind", ["per_subspace", "per_cluster"])
+def test_pq_bucketed_decode_scan_matches_recon(rng, monkeypatch, kind):
+    """Above the recon-cache budget the bucketed engine decodes list
+    blocks on the fly; results must match the recon-cached engine
+    exactly (both decode the same codes to bf16)."""
+    from raft_tpu.neighbors import ivf_pq as pq
+
+    db = rng.normal(size=(3000, 32)).astype(np.float32)
+    Q = rng.normal(size=(100, 32)).astype(np.float32)
+    params = pq.IndexParams(
+        n_lists=16, pq_dim=16, kmeans_n_iters=4,
+        codebook_kind=pq.CodebookGen.PER_CLUSTER if kind == "per_cluster"
+        else pq.CodebookGen.PER_SUBSPACE)
+    idx = pq.build(params, db)
+    sp = pq.SearchParams(n_probes=8, engine="bucketed", bucket_cap=64)
+    dr, ir = pq.search(sp, idx, Q, 5)        # recon path (small index)
+    assert idx._recon is not None
+    idx._recon = None
+    monkeypatch.setattr(pq, "_RECON_AUTO_BYTES", 0)
+    dd, id_ = pq.search(sp, idx, Q, 5)       # decode path
+    assert idx._recon is None                # never materialized the cache
+    np.testing.assert_array_equal(np.asarray(ir), np.asarray(id_))
+    np.testing.assert_allclose(np.asarray(dr), np.asarray(dd),
+                               rtol=1e-3, atol=1e-3)
