@@ -169,7 +169,7 @@ def _family():
     # -- balanced k-means fit (wall; vs_baseline = speedup r1/now)
     Xk, _ = make_blobs(100_000, 64, n_clusters=100, seed=7)
     p = KMeansBalancedParams(n_iters=10)
-    st = wall_stats(lambda: kmeans_balanced.fit(p, Xk, 512))
+    st = wall_stats(lambda: kmeans_balanced.fit(p, Xk, 512), repeats=5)
     _emit("kmeans_balanced_fit_100k_s", st["median_s"], "s",
           _R1["kmeans_balanced_fit_100k_s"] / st["median_s"],
           spread_pct=_spread(st))
