@@ -222,6 +222,8 @@ def _family_1m():
     warm = time.perf_counter() - t0
     builds = []
     for _ in range(3):
+        fidx = None  # free the previous index before rebuilding — two
+        # live 1M indexes force HBM defrag stalls (observed 40x outliers)
         t0 = time.perf_counter()
         fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024), X)
         fence(fidx.data)
