@@ -38,8 +38,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
-from raft_tpu.util.pow2 import ceildiv
+from raft_tpu.util.pow2 import ceildiv, round_up_safe
 from raft_tpu.core.nvtx import traced
 
 
@@ -178,8 +179,6 @@ def _stream_select_min(values, k: int, interpret: bool = False):
     the fast path is final. k ≤ 256 (the reference warpsort cap,
     select_warpsort.cuh:100).
     """
-    from raft_tpu.util.pow2 import round_up_safe
-
     batch, n = values.shape
     bq = min(round_up_safe(batch, 8), 64)
     bp = round_up_safe(batch, bq)
@@ -300,9 +299,6 @@ def select_k(
             # Explicit engine request: validate rather than silently
             # degrade (integer keys would round through f32; too few
             # candidates would crash in the merge top_k).
-            from raft_tpu.core.error import expects
-            from raft_tpu.util.pow2 import round_up_safe
-
             expects(k <= 256,
                     "kStream supports k <= 256 (the warpsort cap)")
             expects(v.dtype in (jnp.dtype(jnp.float32),

@@ -331,6 +331,15 @@ def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
     return index
 
 
+def _resolve_new_ids(index, n_new: int, new_indices):
+    """Default ids continue the global row numbering (same contract as the
+    single-device extend)."""
+    if new_indices is None:
+        base = int(jnp.sum(index.list_sizes))
+        return jnp.arange(base, base + n_new, dtype=index.indices.dtype)
+    return jnp.asarray(new_indices).astype(index.indices.dtype)
+
+
 def sharded_ivf_flat_extend(mesh: Mesh, index: ShardedIvfFlat, new_vectors,
                             new_indices=None) -> ShardedIvfFlat:
     """Append rows to the sharded index in place at O(n_new) per shard
@@ -339,12 +348,7 @@ def sharded_ivf_flat_extend(mesh: Mesh, index: ShardedIvfFlat, new_vectors,
     slots; the shared coarse model is unchanged."""
     X = _flat._as_float(_flat.as_array(new_vectors))
     expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
-    if new_indices is None:
-        base = int(jnp.sum(index.list_sizes))
-        new_indices = jnp.arange(base, base + X.shape[0],
-                                 dtype=index.indices.dtype)
-    else:
-        new_indices = jnp.asarray(new_indices).astype(index.indices.dtype)
+    new_indices = _resolve_new_ids(index, X.shape[0], new_indices)
     labels = kmeans_balanced.predict(
         KMeansBalancedParams(metric=index.metric), index.centers, X)
     return _sharded_extend(mesh, index, "data", X, new_indices, labels)
@@ -356,12 +360,7 @@ def sharded_ivf_pq_extend(mesh: Mesh, index: ShardedIvfPq, new_vectors,
     ivf_pq::extend against the replicated model)."""
     X = _pq._as_float(_pq.as_array(new_vectors))
     expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
-    if new_indices is None:
-        base = int(jnp.sum(index.list_sizes))
-        new_indices = jnp.arange(base, base + X.shape[0],
-                                 dtype=index.indices.dtype)
-    else:
-        new_indices = jnp.asarray(new_indices).astype(index.indices.dtype)
+    new_indices = _resolve_new_ids(index, X.shape[0], new_indices)
     labels, codes = _pq.encode_rows(index, X)
     return _sharded_extend(mesh, index, "pq_codes", codes, new_indices,
                            labels)
