@@ -95,11 +95,13 @@ def _kpass_merge(ad, ai, bd_, bi, k: int, kp: int):
 def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
                       k: int, kp: int, bd: int, n: int, l2: bool, bf16: bool):
     j = pl.program_id(1)
+    single_tile = pl.num_programs(1) == 1
 
-    @pl.when(j == 0)
-    def _():
-        outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
-        outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
+    if not single_tile:
+        @pl.when(j == 0)
+        def _():
+            outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
+            outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
 
     q = q_ref[:]
     y = db_ref[:]
@@ -121,7 +123,11 @@ def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
     work = jnp.where(ids < n, work, jnp.inf)
 
     td, ti = _kpass_select(work, ids, k, kp)
-    nd, ni = _kpass_merge(outd_ref[:], outi_ref[:], td, ti, k, kp)
+    if single_tile:
+        # One db tile: the merge into the all-inf carry is an identity.
+        nd, ni = td, ti
+    else:
+        nd, ni = _kpass_merge(outd_ref[:], outi_ref[:], td, ti, k, kp)
     outd_ref[:] = nd
     outi_ref[:] = ni
 
@@ -187,11 +193,13 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
     invalidity provided by ``bad_ref`` (capacity padding mask). The running
     top-k stays VMEM-resident across the db-tile axis."""
     j = pl.program_id(1)
+    single_tile = pl.num_programs(1) == 1
 
-    @pl.when(j == 0)
-    def _():
-        outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
-        outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
+    if not single_tile:
+        @pl.when(j == 0)
+        def _():
+            outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
+            outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
 
     q = q_ref[0]
     y = db_ref[0]
@@ -214,7 +222,13 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
     work = jnp.where(bad_ref[0], jnp.inf, work)  # (1, bd) broadcasts
 
     td, ti = _kpass_select(work, ids, k, kp)
-    nd, ni = _kpass_merge(outd_ref[0], outi_ref[0], td, ti, k, kp)
+    if single_tile:
+        # One db tile (the common bucketed-IVF case: cap ≤ bd): merging
+        # into the all-inf initial carry is an identity — skip the k-pass
+        # merge, which otherwise costs as much as the select itself.
+        nd, ni = td, ti
+    else:
+        nd, ni = _kpass_merge(outd_ref[0], outi_ref[0], td, ti, k, kp)
     # Starved selection (fewer than k valid rows in this list): selected
     # slots whose value is inf are masked-invalid or already-consumed
     # columns carrying stale real ids — report the -1 sentinel like the
