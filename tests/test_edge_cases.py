@@ -2,6 +2,7 @@
 these through its parameterized gtest grids; SURVEY.md §4)."""
 
 import numpy as np
+import pytest
 
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
@@ -67,3 +68,61 @@ def test_knn_query_batch_of_one(rng):
     d, i = brute_force.knn(db, q, k=4)
     truth = np.argsort(((q - db) ** 2).sum(1))[:4]
     np.testing.assert_array_equal(np.asarray(i)[0], truth)
+
+
+def test_select_k_stream_nan_falls_back_exact(rng):
+    """NaN values poison the audit comparison, which must force the exact
+    fallback rather than silently dropping candidates."""
+    from raft_tpu.matrix.select_k import SelectMethod, select_k
+
+    x = rng.standard_normal((8, 16384)).astype(np.float32)
+    x[3, 100] = np.nan
+    sv, si = select_k(x, 64, method=SelectMethod.kStream)
+    tv, ti = select_k(x, 64, method=SelectMethod.kTopK)
+    np.testing.assert_array_equal(np.asarray(si), np.asarray(ti))
+
+
+def test_extend_zero_rows_is_noop(rng):
+    from raft_tpu.neighbors import ivf_flat
+
+    db = rng.standard_normal((500, 8)).astype(np.float32)
+    idx = ivf_flat.build(ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2),
+                         db)
+    before = idx.size
+    out = ivf_flat.extend(idx, np.zeros((0, 8), np.float32))
+    assert out is idx and idx.size == before
+
+
+def test_sharded_load_shard_count_mismatch(rng, tmp_path):
+    """Loading onto a mesh whose axis size differs from the saved shard
+    count must fail loudly (rank-count-pinned MNMG deserialization)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.core.error import RaftError
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (sharded_ivf_flat_build, sharded_ivf_load,
+                                   sharded_ivf_save)
+
+    db = rng.standard_normal((512, 8)).astype(np.float32)
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("data",))
+    sharded = sharded_ivf_flat_build(
+        mesh8, ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+    base = str(tmp_path / "s8")
+    sharded_ivf_save(base, sharded)
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    with pytest.raises(RaftError, match="shards"):
+        sharded_ivf_load(mesh4, base)
+
+
+def test_sparse_knn_k_exceeds_rows(rng, monkeypatch):
+    from raft_tpu.sparse import distance as sp_distance
+    from raft_tpu.sparse.types import csr_from_dense
+
+    monkeypatch.setattr(sp_distance, "_DENSE_BYTES", 0)
+    a = rng.standard_normal((12, 20)).astype(np.float32)
+    a[np.abs(a) < 1.0] = 0
+    q = rng.standard_normal((5, 20)).astype(np.float32)
+    d, i = sp_distance.knn_blocked(csr_from_dense(a), csr_from_dense(q), 50)
+    assert i.shape == (5, 12)  # clamped to n rows
+    assert (np.asarray(i) >= 0).all()
