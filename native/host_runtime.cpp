@@ -295,6 +295,12 @@ extern "C" int raft_dendrogram_host(
   for (int64_t e = 0; e < n_edges; ++e) {  // reject OOB endpoints cleanly
     if (src[e] < 0 || src[e] >= n || dst[e] < 0 || dst[e] >= n) return -2;
   }
+  for (int64_t e = 0; e < n_edges; ++e) {
+    // A NaN weight breaks the comparator's strict weak ordering (UB in
+    // std::stable_sort); infinities sort but are not meaningful merge
+    // heights. Reject all non-finite weights.
+    if (!std::isfinite(w[e])) return -3;
+  }
   // Stable argsort of the edges by weight (scipy/agglomerative order).
   std::vector<int64_t> order(n_edges);
   for (int64_t i = 0; i < n_edges; ++i) order[i] = i;

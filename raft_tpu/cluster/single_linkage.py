@@ -60,10 +60,24 @@ def _dendrogram(src, dst, w, n: int, n_clusters: int):
     runs in the native C++ runtime (~10 ms at 1M rows); this Python body
     is the fallback when the toolchain is unavailable."""
     from raft_tpu import _native
+    from raft_tpu.core.error import expects
+
+    # Both paths sort identical f32 keys (the native ABI is f32-only; a
+    # f64 fallback sort could disagree on near-tied merge order), and
+    # non-finite weights are rejected up front: NaN breaks stable_sort's
+    # strict weak ordering in the native walk. Finiteness is checked
+    # before AND after the cast so a finite f64 weight overflowing f32
+    # gets the overflow message, not a claim the input was non-finite.
+    w_in = np.asarray(w)
+    expects(bool(np.isfinite(w_in).all()),
+            "single_linkage: MST edge weights must be finite")
+    w = w_in.astype(np.float32)
+    expects(bool(np.isfinite(w).all()),
+            "single_linkage: MST edge weights overflow float32 (the "
+            "dendrogram walk sorts f32 keys); rescale the data")
 
     native = _native.dendrogram_host(np.asarray(src, np.int32),
-                                     np.asarray(dst, np.int32),
-                                     np.asarray(w, np.float32),
+                                     np.asarray(dst, np.int32), w,
                                      n, n_clusters)
     if native is not None:
         return native

@@ -143,6 +143,14 @@ class Index:
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
+    def reset_search_cache(self) -> None:
+        """Drop the memoized auto-engine bucket capacity (measured from
+        the first query batch of each shape — see SearchParams). Call
+        when the query distribution shifts within a batch shape, e.g. a
+        later batch concentrating much harder on a few centroids than
+        the batch the capacity was measured on."""
+        self.__dict__.pop("_auto_cap_cache", None)
+
 
 def _as_float(x) -> jax.Array:
     x = as_array(x)
@@ -368,7 +376,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                 sums / cnt[:, None], centers)
         index.data, index.indices, index.list_sizes = data, ids, sizes
         index.centers = centers
-        index.__dict__.pop("_auto_cap_cache", None)
+        index.reset_search_cache()
         return index
 
     data, ids, sizes, centers = _append_in_place(
@@ -379,7 +387,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     index.data, index.indices, index.list_sizes = data, ids, sizes
     if index.adaptive_centers:
         index.centers = centers
-    index.__dict__.pop("_auto_cap_cache", None)  # occupancy changed
+    index.reset_search_cache()  # occupancy changed
     return index
 
 
@@ -512,9 +520,8 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     ~2× contention drift), so a later same-shape batch that concentrates
     much harder on one centroid can overflow it and drop lower-ranked
     probes of the hot list. Callers whose distribution shifts should pass
-    an explicit ``bucket_cap`` or drop the memo (``del index.__dict__
-    ['_auto_cap_cache']``); extend() invalidates it when occupancy
-    changes.
+    an explicit ``bucket_cap`` or call ``index.reset_search_cache()``;
+    extend() invalidates the memo when occupancy changes.
     """
     expects(engine in ("auto", "scan", "bucketed"),
             f"unknown engine {engine!r} (auto|scan|bucketed)")
@@ -593,11 +600,11 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
     return engine, cap_q
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9))
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10))
 def _bucketed_probe_scan(
     queries, data, indices, list_sizes, probe_ids,
     k: int, inner_is_l2: bool, sqrt: bool, bucket_cap: int,
-    interpret: bool = False,
+    interpret: bool = False, qsplit: bool = False,
 ):
     """Probe scan with the probe map inverted to per-list query buckets.
 
@@ -627,7 +634,8 @@ def _bucketed_probe_scan(
     bd_, bi_ = fused_batch_knn(
         Qb, data, invalid, k,
         metric="l2" if inner_is_l2 else "ip",
-        bf16=data.dtype == jnp.bfloat16, interpret=interpret)
+        bf16=data.dtype == jnp.bfloat16, qsplit=qsplit,
+        interpret=interpret)
     gi = indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
                  jnp.maximum(bi_, 0)]                          # (L, cap_q, kk)
     gi = jnp.where(bi_ < 0, -1, gi)
@@ -715,10 +723,14 @@ def search(
         # 8-bit integer storage (the reference's ivf_flat<int8/uint8>
         # instantiations, ivf_flat_search.cuh:456): 8-bit values are
         # exact in bf16, so the scoring rides the bf16 MXU path at half
-        # the f32 staging bandwidth; norms accumulate in f32 below.
+        # the f32 staging bandwidth; norms accumulate in f32 below, and
+        # the bucketed kernel keeps f32 *query* precision via the split
+        # hi/lo matmul (qsplit) so real-valued queries are not rounded.
         dataf = index.data.astype(jnp.bfloat16)
+        qsplit = True
     else:
         dataf = _as_float(index.data)
+        qsplit = False
 
     engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
                                  index.n_lists, k, params.bucket_cap,
@@ -728,7 +740,7 @@ def search(
         return _bucketed_probe_scan(
             Q, dataf, index.indices, index.list_sizes, probe_ids,
             k, inner_is_l2, sqrt, cap_q,
-            jax.default_backend() != "tpu")
+            jax.default_backend() != "tpu", qsplit)
 
     if inner_is_l2:
         # f32-accumulated norms without materializing a full f32 copy of

@@ -233,6 +233,13 @@ class Index:
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
+    def reset_search_cache(self) -> None:
+        """Drop the memoized auto-engine bucket capacity (measured from
+        the first query batch of each shape). The bf16 reconstruction
+        cache is kept — it depends only on the stored codes, not on the
+        query distribution (extend() invalidates both)."""
+        self.__dict__.pop("_auto_cap_cache", None)
+
     def reconstructed(self) -> jax.Array:
         """Absolute reconstruction of every stored vector in rotated space,
         bf16: ``recon[l, c] = R·center_l + codeword(codes[l, c])``.
@@ -632,7 +639,7 @@ def _invalidate_caches(index: Index) -> None:
     bf16 reconstruction (stale codes/capacity would silently corrupt
     bucketed search) and the measured bucket-capacity memo."""
     index._recon = None
-    index.__dict__.pop("_auto_cap_cache", None)
+    index.reset_search_cache()
 
 
 def encode_rows(model, X) -> Tuple[jax.Array, jax.Array]:

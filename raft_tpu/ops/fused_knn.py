@@ -186,7 +186,8 @@ def _fused_knn(queries, db, k: int, l2: bool, sqrt: bool,
 
 
 def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
-                      k: int, kp: int, bd: int, l2: bool, bf16: bool):
+                      k: int, kp: int, bd: int, l2: bool, bf16: bool,
+                      qsplit: bool):
     """One (batch, db-tile) grid cell of the batched independent kNN: same
     distance-tile + k-pass selection as ``_fused_knn_kernel``, but each
     batch element b searches only its own database slab, with per-slot
@@ -203,14 +204,31 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
 
     q = q_ref[0]
     y = db_ref[0]
-    if bf16:
-        qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    dims = (((1,), (1,)), ((), ()))
+    if bf16 and qsplit:
+        # Quantized storage (u8/i8 exact in bf16) with *float* queries:
+        # a plain bf16 cast of the query operand would round real-valued
+        # queries and perturb rankings. Split the query into a bf16
+        # high part + bf16 residual — two bf16 MXU passes recover the
+        # f32·bf16 product to ~2^-16 relative error while the db operand
+        # stays on the fast bf16 path (the matmul is a small fraction of
+        # the bucketed step, so the second pass is cheap).
+        yc = y.astype(jnp.bfloat16)
+        qh = q.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        g = (jax.lax.dot_general(qh, yc, dimension_numbers=dims,
+                                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(ql, yc, dimension_numbers=dims,
+                                   preferred_element_type=jnp.float32))
     else:
-        qc, yc = q, y
-    g = jax.lax.dot_general(
-        qc, yc, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=(None if bf16 else jax.lax.Precision.HIGHEST))
+        if bf16:
+            qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+        else:
+            qc, yc = q, y
+        g = jax.lax.dot_general(
+            qc, yc, dimension_numbers=dims,
+            preferred_element_type=jnp.float32,
+            precision=(None if bf16 else jax.lax.Precision.HIGHEST))
     if l2:
         yf = y.astype(jnp.float32)  # norms in f32 even for bf16-stored db
         qn = jnp.sum(q * q, axis=1, keepdims=True)
@@ -240,9 +258,10 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "l2", "sqrt", "bd", "bf16", "interpret"))
+    static_argnames=("k", "l2", "sqrt", "bd", "bf16", "qsplit",
+                     "interpret"))
 def _fused_batch_knn(queries, db, bad, k: int, l2: bool, sqrt: bool,
-                     bd: int, bf16: bool, interpret: bool):
+                     bd: int, bf16: bool, qsplit: bool, interpret: bool):
     B, m, d = queries.shape
     n = db.shape[1]
     kp = round_up_safe(max(k, 1), _LANES)
@@ -261,7 +280,8 @@ def _fused_batch_knn(queries, db, bad, k: int, l2: bool, sqrt: bool,
     nb = np_ // bd
 
     kernel = functools.partial(
-        _batch_knn_kernel, k=k, kp=kp, bd=bd, l2=l2, bf16=bf16)
+        _batch_knn_kernel, k=k, kp=kp, bd=bd, l2=l2, bf16=bf16,
+        qsplit=qsplit)
     outd, outi = pl.pallas_call(
         kernel,
         grid=(B, nb),
@@ -300,6 +320,7 @@ def _fused_batch_knn(queries, db, bad, k: int, l2: bool, sqrt: bool,
 
 def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
                     sqrt: bool = False, bd: int = 0, bf16: bool = False,
+                    qsplit: bool = False,
                     interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Batched independent fused kNN: element b searches ``queries[b]``
     (m, d) against ``db[b]`` (n, d) with per-slot mask ``invalid[b]`` (n,)
@@ -307,7 +328,10 @@ def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
     per probed list; ref: interleaved_scan_kernel's one-block-per-(query,
     probe) decomposition, detail/ivf_flat_search.cuh:669, re-tiled for the
     MXU). A bf16 ``db`` is accepted as-is when ``bf16=True`` (the IVF-PQ
-    reconstruction cache) — norms/accumulation stay f32.
+    reconstruction cache) — norms/accumulation stay f32. ``qsplit``
+    keeps f32 query precision on the bf16 path via a split hi/lo double
+    matmul (for exactly-representable quantized storage, where query
+    rounding would be the only error source).
     Returns (distances (B, m, k), local indices (B, m, k))."""
     queries = jnp.asarray(queries, jnp.float32)
     db = jnp.asarray(db)
@@ -325,7 +349,7 @@ def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
     bd = max(_LANES, bd // _LANES * _LANES)
     bd = min(bd, round_up_safe(n, _LANES))
     return _fused_batch_knn(queries, db, invalid, k, metric == "l2", sqrt,
-                            bd, bf16, interpret)
+                            bd, bf16, qsplit, interpret)
 
 
 def fused_knn_supported(m: int, n: int, d: int, k: int) -> bool:

@@ -392,12 +392,38 @@ def sharded_ivf_save(basename: str, index) -> None:
             pq_bits=np.int64(index.pq_bits),
             pq_dim=np.int64(index.pq_dim),
         )
-    np.savez(f"{basename}.model.npz", **model)
-    store = np.asarray(index.pq_codes if is_pq else index.data)
-    ids = np.asarray(index.indices)
-    sizes = np.asarray(index.list_sizes)
-    for s in range(store.shape[0]):
-        np.savez(f"{basename}.shard{s}.npz", store=store[s],
+    # The replicated model is identical on every process — only process 0
+    # writes it, or N processes would race on the same file path.
+    if jax.process_index() == 0:
+        np.savez(f"{basename}.model.npz", **model)
+    store = index.pq_codes if is_pq else index.data
+
+    # Each process writes only the shards it can address: on a
+    # multi-process (jax.distributed) mesh the global arrays are not
+    # fully addressable and np.asarray(whole_array) would raise. Files
+    # are keyed by the shard's global position along the leading
+    # (device) axis, so the union of all processes' files is the
+    # complete index and the single-process layout is unchanged.
+    def by_start(arr):
+        out = {}
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                # On a multi-axis mesh the shard tensors are replicated
+                # over the non-data axes; only one replica writes each
+                # shard file (same-path race as the model.npz gate).
+                continue
+            start = sh.index[0].start or 0
+            data = np.asarray(sh.data)
+            # One leading-axis row per device under P(axis); a process
+            # with several local devices contributes several entries.
+            for off in range(data.shape[0]):
+                out[start + off] = data[off]
+        return out
+
+    stores, ids, sizes = (by_start(a) for a in
+                          (store, index.indices, index.list_sizes))
+    for s, payload in stores.items():
+        np.savez(f"{basename}.shard{s}.npz", store=payload,
                  indices=ids[s], list_sizes=sizes[s])
 
 
@@ -416,19 +442,42 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
                 f"index has {n_shards} shards but mesh[{axis!r}] = "
                 f"{mesh.shape[axis]}")
         model = {k: m[k] for k in m.files}
-    shards = [np.load(f"{basename}.shard{s}.npz") for s in range(n_shards)]
     sharding = NamedSharding(mesh, P(axis))
-    ids_h = np.stack([z["indices"] for z in shards])
-    # int64 ids require x64 — without the guard jnp.asarray silently
-    # truncates (same contract as ivf_flat.load / ivf_pq.load).
-    validate_idx_dtype(ids_h.dtype)
-    store = jax.device_put(
-        jnp.asarray(np.stack([z["store"] for z in shards])), sharding)
-    ids = jax.device_put(jnp.asarray(ids_h), sharding)
-    sizes = jax.device_put(
-        jnp.asarray(np.stack([z["list_sizes"] for z in shards])), sharding)
-    for z in shards:
-        z.close()
+    with np.load(f"{basename}.shard0.npz") as z0:
+        shapes = {k: (z0[k].shape, z0[k].dtype)
+                  for k in ("store", "indices", "list_sizes")}
+    # int64 ids require x64 — without the guard the device placement
+    # silently truncates (same contract as ivf_flat.load / ivf_pq.load).
+    validate_idx_dtype(shapes["indices"][1])
+
+    # Each process materializes only the shards addressable on its own
+    # devices (the callback receives the global index of one shard) —
+    # the multi-process-safe inverse of sharded_ivf_save. Shard files
+    # are read once each and closed (all three keys per open).
+    shard_cache: dict = {}
+
+    def shard_arrays(s: int):
+        if s not in shard_cache:
+            with np.load(f"{basename}.shard{s}.npz") as z:
+                shard_cache[s] = {k: z[k] for k in
+                                  ("store", "indices", "list_sizes")}
+        return shard_cache[s]
+
+    def placed(key):
+        shape, dtype = shapes[key]
+
+        def cb(index):
+            rows = range(*index[0].indices(n_shards))
+            return np.stack([shard_arrays(s)[key] for s in rows]
+                            ).astype(dtype, copy=False)
+
+        return jax.make_array_from_callback((n_shards,) + shape,
+                                            sharding, cb)
+
+    store = placed("store")
+    ids = placed("indices")
+    sizes = placed("list_sizes")
+    shard_cache.clear()
     centers = jnp.asarray(model["centers"])
     if kind == "pq":
         return ShardedIvfPq(

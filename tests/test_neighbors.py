@@ -308,6 +308,32 @@ class TestIvfFlatQuantized:
             np.testing.assert_allclose(np.asarray(d8), np.asarray(df),
                                        rtol=1e-5, atol=1e-2)
 
+    @pytest.mark.parametrize("dtype", [np.uint8, np.int8])
+    def test_quantized_float_queries(self, rng, dtype):
+        """Non-integer float queries against quantized storage: the
+        bucketed engine's split hi/lo query matmul (qsplit) must keep f32
+        query precision — a plain bf16 query cast would perturb rankings
+        vs the scan engine, which scores bf16-stored rows with f32
+        queries (ADVICE r3: the parity test above only used
+        integer-valued queries)."""
+        lo, hi = (0, 256) if dtype == np.uint8 else (-128, 128)
+        db = rng.integers(lo, hi, size=(4000, 32)).astype(dtype)
+        q = db[:40].astype(np.float32) + rng.normal(
+            scale=0.37, size=(40, 32)).astype(np.float32)
+        idx8 = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+        ds, is_ = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=16, engine="scan"), idx8, q, 5)
+        dbk, ibk = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=16, engine="bucketed",
+                                  bucket_cap=64), idx8, q, 5)
+        np.testing.assert_array_equal(np.asarray(is_), np.asarray(ibk))
+        # atol covers f32 cancellation noise in qn+yn-2g at ~5e5-magnitude
+        # squared norms (~|x|^2*eps*n_ops); without qsplit the bf16 query
+        # rounding error is ~1000x this and the index assert above fails.
+        np.testing.assert_allclose(np.asarray(ds), np.asarray(dbk),
+                                   rtol=1e-4, atol=5.0)
+
     def test_quantized_extend_and_roundtrip(self, rng, tmp_path):
         db = rng.integers(0, 256, size=(2000, 16)).astype(np.uint8)
         idx = ivf_flat.build(
