@@ -155,6 +155,50 @@ def bench_neighbors(rng, quick: bool):
            n_db=n, dim=d, n_probes=n_probes, k=k)
 
 
+def bench_sparse(rng, quick: bool):
+    """Ref: SPARSE_BENCH (cpp/bench/CMakeLists.txt:116-121 — csr convert +
+    sparse distance/knn shapes)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.sparse.convert import dense_to_csr
+    from raft_tpu.sparse.distance import pairwise_distance as sp_pairwise
+    from raft_tpu.sparse.neighbors import brute_force_knn as sp_knn
+    from raft_tpu.sparse.types import CSR
+    from raft_tpu.distance.distance_types import DistanceType
+
+    m, n, d, density = (128, 256, 512, 0.05) if quick \
+        else (1024, 8192, 16384, 0.002)
+    k = 10
+
+    def make_csr(rows):
+        nnz_row = max(1, int(d * density))
+        cols = rng.integers(0, d, size=(rows, nnz_row)).astype(np.int32)
+        cols = np.sort(cols, axis=1)
+        vals = rng.normal(size=(rows, nnz_row)).astype(np.float32)
+        indptr = np.arange(rows + 1, dtype=np.int32) * nnz_row
+        return CSR(jnp.asarray(indptr), jnp.asarray(cols.reshape(-1)),
+                   jnp.asarray(vals.reshape(-1)), (rows, d))
+
+    xq = make_csr(m)
+    yb = make_csr(n)
+
+    sec = wall_time(lambda: sp_pairwise(
+        xq, yb, metric=DistanceType.L2Expanded).block_until_ready())
+    report("sparse", "pairwise_l2", sec, m * n, unit="pairs/s",
+           m=m, n=n, d=d, density=density)
+    sec = wall_time(lambda: sp_knn(yb, xq, k)[0].block_until_ready())
+    report("sparse", "bf_knn", sec, m, unit="qps",
+           m=m, n=n, d=d, density=density, k=k)
+
+    dm, dn = (256, 256) if quick else (2048, 2048)
+    dense = _data(rng, dm, dn)
+    dense[dense < 1.5] = 0.0   # ~7% density
+    dense_j = jnp.asarray(dense)
+    sec = wall_time(lambda: dense_to_csr(dense_j).vals.block_until_ready())
+    report("sparse", "dense_to_csr", sec, dm * dn, unit="elems/s",
+           m=dm, n=dn)
+
+
 FAMILIES = {
     "distance": bench_distance,
     "linalg": bench_linalg,
@@ -162,6 +206,7 @@ FAMILIES = {
     "random": bench_random,
     "cluster": bench_cluster,
     "neighbors": bench_neighbors,
+    "sparse": bench_sparse,
 }
 
 

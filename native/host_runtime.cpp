@@ -127,6 +127,22 @@ int raft_write_fvecs(const char* path, int64_t rows, int64_t cols,
   return 0;
 }
 
+int raft_write_bvecs(const char* path, int64_t rows, int64_t cols,
+                     const uint8_t* data) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int32_t dim = (int32_t)cols;
+  for (int64_t r = 0; r < rows; ++r) {
+    if (std::fwrite(&dim, sizeof(int32_t), 1, f) != 1 ||
+        std::fwrite(data + r * cols, 1, cols, f) != (size_t)cols) {
+      std::fclose(f);
+      return -2;
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
 // ---------------------------------------------------------------------------
 // Host refine: exact re-rank of candidate lists (ref detail/refine.cuh:162,
 // the host OpenMP path). metric: 0 = sqeuclidean, 1 = inner product.

@@ -39,6 +39,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.raft_read_bvecs.argtypes = [ctypes.c_char_p, p_i64, p_i64, p_u8]
     lib.raft_read_ivecs.argtypes = [ctypes.c_char_p, p_i64, p_i64, p_i32]
     lib.raft_write_fvecs.argtypes = [ctypes.c_char_p, i64, i64, p_f32]
+    lib.raft_write_bvecs.argtypes = [ctypes.c_char_p, i64, i64, p_u8]
     lib.raft_refine_host.argtypes = [
         p_f32, i64, i64, p_f32, i64, p_i64, i64, i64, ctypes.c_int,
         p_f32, p_i64]
@@ -51,7 +52,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         p_i32, p_i32, p_f32, i64, i64, i64, p_i64, p_f64, p_i64, p_i32,
         p_i64]
     for fn in (lib.raft_read_fvecs, lib.raft_read_bvecs, lib.raft_read_ivecs,
-               lib.raft_write_fvecs, lib.raft_refine_host,
+               lib.raft_write_fvecs, lib.raft_write_bvecs,
+               lib.raft_refine_host,
                lib.raft_knn_merge_parts, lib.raft_select_k_host,
                lib.raft_dendrogram_host):
         fn.restype = ctypes.c_int
@@ -148,6 +150,18 @@ def write_fvecs(path: str, data: np.ndarray) -> None:
         return
     rc = lib.raft_write_fvecs(path.encode(), data.shape[0], data.shape[1],
                               _ptr(data, ctypes.c_float))
+    if rc != 0:
+        raise IOError(f"failed to write {path} (rc={rc})")
+
+
+def write_bvecs(path: str, data: np.ndarray) -> None:
+    data = np.ascontiguousarray(data, np.uint8)
+    lib = get_lib()
+    if lib is None:
+        _write_vecs_numpy(path, data)
+        return
+    rc = lib.raft_write_bvecs(path.encode(), data.shape[0], data.shape[1],
+                              _ptr(data, ctypes.c_uint8))
     if rc != 0:
         raise IOError(f"failed to write {path} (rc={rc})")
 

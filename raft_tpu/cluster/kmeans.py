@@ -289,27 +289,69 @@ def find_k(
     tol: float = 1e-2,
     seed: int = 0,
 ) -> Tuple[int, jax.Array, jax.Array]:
-    """Auto-select k by the elbow ("trough") of inertia-vs-k, binary-search
-    style. Ref: raft::cluster::kmeans::find_k (cluster/kmeans.cuh:306,
-    detail/kmeans_auto_find_k.cuh). Returns ``(best_k, inertia, n_iter)``.
+    """Auto-select k by binary search on the Calinski-Harabasz-style
+    objective ``(n - k)/(k - 1) * dispersion(k)/inertia(k)`` -- O(log kmax)
+    fits instead of a linear scan of full fits.
+
+    Ref: raft::cluster::kmeans::find_k (cluster/kmeans.cuh:306 ->
+    detail/kmeans_auto_find_k.cuh:107-229): evaluate the objective at
+    [left, mid, right]; when its slope rises left-of-mid and falls
+    right-of-mid the peak is in the left half (right = mid), else the
+    search moves right (left = mid); a fit whose inertia lands above the
+    left edge's retries up to 3 times with a reseeded init, like the
+    reference's ``tests < 3`` loop. Returns ``(best_k, inertia,
+    n_iter)`` of the winning fit.
     """
-    X = _as_float(X)
     from raft_tpu.random.rng_state import RngState
+    from raft_tpu.stats.descriptive import dispersion
 
-    def run(k):
-        p = KMeansParams(n_clusters=int(k), max_iter=max_iter, tol=tol,
-                         rng_state=RngState(seed=seed))
-        c, inertia, it = fit(p, X)
-        return float(inertia), it
+    X = _as_float(X)
+    n = X.shape[0]
+    expects(kmax <= n, "kmax must be <= number of rows in X")
+    expects(kmax >= 2, "find_k needs kmax >= 2 (the Calinski-Harabasz "
+            "objective is undefined at k=1; the reference's search floor "
+            "is 2, kmeans_auto_find_k.cuh:111)")
+    left = max(kmin, 2)             # the objective needs k >= 2
+    right = max(kmax, left)
+    memo: dict = {}
 
-    # Coarse scan like the reference's trough detection over the idealized
-    # 1/k cost curve: pick the k where relative improvement drops below tol.
-    best_k, best_inertia, best_it = kmin, None, 0
-    prev = None
-    for k in range(kmin, kmax + 1):
-        inertia, it = run(k)
-        if prev is not None and prev - inertia <= tol * max(prev, 1e-30):
-            break
-        best_k, best_inertia, best_it = k, inertia, it
-        prev = inertia
-    return best_k, jnp.asarray(best_inertia), best_it
+    def run(k: int, floor_inertia=None):
+        """Fit k clusters (memoized); retry a fit that lands above the
+        current left edge's inertia -- k-means stuck in a bad init."""
+        if k in memo:
+            return memo[k]
+        best = None
+        for attempt in range(3):
+            p = KMeansParams(n_clusters=int(k), max_iter=max_iter, tol=tol,
+                             rng_state=RngState(seed=seed + attempt))
+            centroids, inertia, it = fit(p, X)
+            inertia = float(inertia)
+            if best is None or inertia < best[0]:
+                labels, _ = predict(p, centroids, X)
+                sizes = jnp.bincount(labels, length=int(k))
+                disp = float(dispersion(centroids, sizes, n_points=n))
+                best = (inertia, disp, it)
+            if floor_inertia is None or best[0] <= floor_inertia:
+                break
+        memo[k] = best
+        return best
+
+    def objective(k: int) -> float:
+        inertia, disp, _ = memo[k]
+        return (n - k) / (k - 1) * disp / max(inertia, 1e-30)
+
+    run(left)
+    if right > left:
+        run(right, floor_inertia=memo[left][0])
+    while left < right - 1:
+        mid = (left + right) // 2
+        run(mid, floor_inertia=memo[left][0])
+        slope_l = (objective(mid) - objective(left)) / (mid - left)
+        slope_r = (objective(right) - objective(mid)) / (right - mid)
+        if slope_l > 0 and slope_r < 0:
+            right = mid
+        else:
+            left = mid
+    best_k = right if objective(right) >= objective(left) else left
+    inertia, _, it = memo[best_k]
+    return best_k, jnp.asarray(inertia), it

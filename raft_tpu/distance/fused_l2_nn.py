@@ -5,10 +5,14 @@ Ref: cpp/include/raft/distance/fused_l2_nn.cuh (public
 inner loop. The reference fuses the distance tile and a KeyValuePair min
 reduction inside one CUDA kernel to avoid materializing the (m, n) matrix.
 
-TPU-native: the same fusion is expressed as a ``lax.scan`` over column (y)
-tiles — each step computes a gram tile on the MXU, forms the expanded L2
-epilogue, and folds a running (min, argmin) carry. XLA keeps the tile in
-registers/VMEM; the (m, n) matrix never hits HBM.
+TPU-native: on TPU the k=1 specialization of the fused Pallas kNN kernel
+(ops/fused_knn.py) runs the gram tile + arg-min epilogue with the (m, n)
+tile VMEM-resident — the round-3 ``lax.scan`` formulation left XLA
+round-tripping the distance tile through HBM at ~3% MFU. ``bf16`` selects
+the MXU precision tier: None keeps f32 (HIGHEST) accumulation like the
+reference, "split" rounds only the y (centroid) operand and recovers x via
+a hi/lo double matmul, "full" rounds both. Off-TPU (and for the tiled
+fallback) the same fusion is a ``lax.scan`` over column tiles.
 """
 
 from __future__ import annotations
@@ -37,13 +41,20 @@ def fused_l2_nn_min_reduce(
     sqrt: bool = False,
     tile_n: int = _TILE_N,
     precision=DEFAULT_PRECISION,
+    bf16: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """For each row of ``x``, the L2-nearest row of ``y``.
 
     Ref: fusedL2NNMinReduce (fused_l2_nn.cuh:205) with
     MinAndDistanceReduceOp — returns ``(min_dist (m,), argmin (m,) int32)``.
-    ``sqrt=True`` returns true L2 instead of squared.
+    ``sqrt=True`` returns true L2 instead of squared. ``bf16`` picks the
+    MXU tier on the TPU kernel path: None = f32 (reference-parity
+    accumulation), "split" = y rounded to bf16, x recovered by a hi/lo
+    double matmul (~2^-16 relative x error — near-tied argmins may flip
+    on the y rounding only), "full" = both operands bf16.
     """
+    expects(bf16 in (None, "split", "full"),
+            f"bf16 must be None, 'split' or 'full' (got {bf16!r})")
     x = as_array(x)
     y = as_array(y)
     expects(x.ndim == 2 and y.ndim == 2, "x and y must be matrices")
@@ -55,11 +66,39 @@ def fused_l2_nn_min_reduce(
     m, k = x.shape
     n = y.shape[0]
 
+    if (jax.default_backend() == "tpu" and x.dtype == jnp.float32
+            and y.dtype == jnp.float32 and k <= 1024 and n >= 2
+            and precision in (DEFAULT_PRECISION, lax.Precision.HIGHEST)):
+        # Pallas fused kernel (k=1 top-k queue): the (m, n) tile never
+        # leaves VMEM. Ref: detail/fused_l2_nn.cuh:129.
+        from raft_tpu.ops.fused_knn import fused_knn
+
+        d1, i1 = fused_knn(x, y, 1, metric="l2", sqrt=sqrt,
+                           bf16=bf16 is not None, qsplit=bf16 == "split")
+        return d1[:, 0], i1[:, 0]
+
+    def mm(a, bt):
+        """x·yᵀ gram honoring the requested bf16 tier — the XLA fallback
+        keeps the same numerics as the TPU kernel path, so bf16 requests
+        never silently run a different precision off-TPU."""
+        if bf16 == "full":
+            return jnp.matmul(a.astype(jnp.bfloat16),
+                              bt.astype(jnp.bfloat16),
+                              preferred_element_type=jnp.float32)
+        if bf16 == "split":
+            ah = a.astype(jnp.bfloat16)
+            al = (a - ah.astype(jnp.float32)).astype(jnp.bfloat16)
+            bb = bt.astype(jnp.bfloat16)
+            return (jnp.matmul(ah, bb, preferred_element_type=jnp.float32)
+                    + jnp.matmul(al, bb,
+                                 preferred_element_type=jnp.float32))
+        return jnp.matmul(a, bt, precision=precision)
+
     xn = jnp.sum(x * x, axis=1)  # (m,)
 
     if n <= tile_n:
         yn = jnp.sum(y * y, axis=1)
-        d = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * jnp.matmul(x, y.T, precision=precision), 0.0)
+        d = jnp.maximum(xn[:, None] + yn[None, :] - 2.0 * mm(x, y.T), 0.0)
         idx = jnp.argmin(d, axis=1).astype(jnp.int32)
         dmin = jnp.take_along_axis(d, idx[:, None], axis=1)[:, 0]
         return (jnp.sqrt(dmin) if sqrt else dmin), idx
@@ -81,7 +120,7 @@ def fused_l2_nn_min_reduce(
     def body(carry, tile):
         best_d, best_i, base = carry
         yt, ynt = tile
-        d = jnp.maximum(xn[:, None] + ynt[None, :] - 2.0 * jnp.matmul(x, yt.T, precision=precision), 0.0)
+        d = jnp.maximum(xn[:, None] + ynt[None, :] - 2.0 * mm(x, yt.T), 0.0)
         ti = jnp.argmin(d, axis=1).astype(jnp.int32)
         td = jnp.take_along_axis(d, ti[:, None], axis=1)[:, 0]
         upd = td < best_d

@@ -195,8 +195,12 @@ class Index:
     pq_dim: int = 0
     conservative_memory_allocation: bool = False
     # Lazy bf16 reconstruction cache (n_lists, cap, rot_dim) backing the
-    # bucketed search engine; see reconstructed(). Not serialized.
+    # recon-tier bucketed search engine; see reconstructed(). Not
+    # serialized.
     _recon: Optional[jax.Array] = None
+    # Lazy compressed-scan operands (transposed codes + per-list absolute
+    # codeword tables); see compressed_scan_operands(). Not serialized.
+    _scan_ops: Optional[tuple] = None
 
     def __post_init__(self):
         # pq_dim is load-bearing (codes are bit-packed, so it is no longer
@@ -239,6 +243,29 @@ class Index:
         cache is kept — it depends only on the stored codes, not on the
         query distribution (extend() invalidates both)."""
         self.__dict__.pop("_auto_cap_cache", None)
+
+    def compressed_scan_operands(self) -> tuple:
+        """Cached operands of the compressed-domain Pallas scan
+        (ops/pq_scan.py): ``(codesT, abs_lo, abs_hi)`` — the transposed
+        packed codes (= codes size) and the per-list absolute codeword
+        tables (n_lists·rot_dim·max(B,128) f32, ~4× the codes at the
+        default config; far below the decompressed index). Rebuilt
+        lazily after extend(); PER_SUBSPACE + pq_bits∈{4,8} only."""
+        if self._scan_ops is None:
+            from raft_tpu.ops.pq_scan import (absolute_book_tables,
+                                              permute_subspaces)
+            codesT = jnp.swapaxes(self.pq_codes, 1, 2)
+            centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
+                                     precision=lax.Precision.HIGHEST)
+            crot_p = permute_subspaces(centers_rot, self.pq_dim,
+                                       self.pq_bits)
+            abs_lo, abs_hi = absolute_book_tables(self.pq_centers, crot_p,
+                                                  self.pq_bits)
+            ops = (codesT, abs_lo, abs_hi)
+            if isinstance(codesT, jax.core.Tracer):
+                return ops
+            object.__setattr__(self, "_scan_ops", ops)
+        return self._scan_ops
 
     def reconstructed(self) -> jax.Array:
         """Absolute reconstruction of every stored vector in rotated space,
@@ -402,6 +429,52 @@ def _bucketed_decode_scan(
     cd, ci = _route_candidates(bd_, gi, route, q, probe_ids.shape[1],
                                bucket_cap, worst)
     return select_k(cd, k, select_min=not is_ip, indices=ci)
+
+
+def _compressed_supported(index: Index) -> bool:
+    """The compressed-domain Pallas scan covers the default config family:
+    per-subspace codebooks with byte-aligned code fields (pq_bits=8, or
+    pq_bits=4 with an even pq_dim — odd pq_dim leaves a half-byte field
+    the nibble unpack cannot split). Other configs fall back to the
+    recon / LUT-scan tiers."""
+    return (index.codebook_kind == CodebookGen.PER_SUBSPACE
+            and (index.pq_bits == 8
+                 or (index.pq_bits == 4 and index.pq_dim % 2 == 0)))
+
+
+def _compressed_bucketed_scan(rotq, index: Index, probe_ids, k: int,
+                              is_ip: bool, bucket_cap: int,
+                              interpret: bool):
+    """Bucketed search over the bit-packed codes via the compressed-domain
+    Pallas kernel (ops/pq_scan.py) — the ivf_pq_search.cuh:611 parity
+    tier: memory is the packed codes + the cached scan operands +
+    O(group) VMEM workspace (no decompressed index at any scale)."""
+    from raft_tpu.ops.pq_scan import permute_subspaces, pq_fused_scan
+
+    q = rotq.shape[0]
+    n_lists, cap, _ = index.pq_codes.shape
+    J, bits = index.pq_dim, index.pq_bits
+
+    bucket, route = _invert_probe_map(probe_ids, n_lists, bucket_cap)
+    rotq_p = permute_subspaces(rotq, J, bits)
+    Qb = rotq_p[jnp.maximum(bucket, 0)]            # (n_lists, cap_q, d)
+    invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+               >= index.list_sizes[:, None])
+
+    codesT, abs_lo, abs_hi = index.compressed_scan_operands()
+    bd_, bi_ = pq_fused_scan(Qb, codesT, abs_lo, abs_hi, invalid, k, J,
+                             bits, is_ip, interpret)
+    gi = index.indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
+                       jnp.maximum(bi_, 0)]
+    gi = jnp.where(bi_ < 0, -1, gi)
+    # The kernel reports min-selection order for both metrics (negated
+    # inner products); route with +inf worst and undo the negation after.
+    cd, ci = _route_candidates(bd_, gi, route, q, probe_ids.shape[1],
+                               bucket_cap, jnp.inf)
+    best_d, best_i = select_k(cd, k, select_min=True, indices=ci)
+    if is_ip:
+        best_d = -best_d
+    return best_d, best_i
 
 
 def _as_float(x) -> jax.Array:
@@ -637,8 +710,10 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
 def _invalidate_caches(index: Index) -> None:
     """Drop derived per-index caches after a storage mutation: the lazy
     bf16 reconstruction (stale codes/capacity would silently corrupt
-    bucketed search) and the measured bucket-capacity memo."""
+    bucketed search), the compressed-scan operands, and the measured
+    bucket-capacity memo."""
     index._recon = None
+    index._scan_ops = None
     index.reset_search_cache()
 
 
@@ -894,6 +969,17 @@ def search(
         recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
             * index.rot_dim * 2
         interpret = jax.default_backend() != "tpu"
+        if _compressed_supported(index) and index._recon is None:
+            # Default compressed-domain tier: the Pallas kernel scores the
+            # bit-packed codes directly (ivf_pq_search.cuh:611 parity) —
+            # no decompressed copy of the index at any scale. A
+            # pre-built reconstruction cache (index.reconstructed())
+            # opts into the recon tier below.
+            best_d, best_i = _compressed_bucketed_scan(
+                rotq, index, probe_ids, k, is_ip, cap_q, interpret)
+            if index.metric == DistanceType.L2SqrtExpanded:
+                best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+            return best_d, best_i
         if index._recon is not None or recon_bytes <= _RECON_AUTO_BYTES:
             # Small index or a user-precomputed cache: score against the
             # resident bf16 reconstruction (fastest steady-state).

@@ -93,7 +93,8 @@ def _kpass_merge(ad, ai, bd_, bi, k: int, kp: int):
 
 
 def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
-                      k: int, kp: int, bd: int, n: int, l2: bool, bf16: bool):
+                      k: int, kp: int, bd: int, n: int, l2: bool, bf16: bool,
+                      qsplit: bool):
     j = pl.program_id(1)
     single_tile = pl.num_programs(1) == 1
 
@@ -105,14 +106,26 @@ def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
 
     q = q_ref[:]
     y = db_ref[:]
-    if bf16:
-        qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    dims = (((1,), (1,)), ((), ()))
+    if bf16 and qsplit:
+        # Split hi/lo query matmul: f32 query precision on the bf16 MXU
+        # path (see _batch_knn_kernel) — only the db operand is rounded.
+        yc = y.astype(jnp.bfloat16)
+        qh = q.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        g = (jax.lax.dot_general(qh, yc, dimension_numbers=dims,
+                                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(ql, yc, dimension_numbers=dims,
+                                   preferred_element_type=jnp.float32))
     else:
-        qc, yc = q, y
-    g = jax.lax.dot_general(
-        qc, yc, dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-        precision=(None if bf16 else jax.lax.Precision.HIGHEST))
+        if bf16:
+            qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+        else:
+            qc, yc = q, y
+        g = jax.lax.dot_general(
+            qc, yc, dimension_numbers=dims,
+            preferred_element_type=jnp.float32,
+            precision=(None if bf16 else jax.lax.Precision.HIGHEST))
     if l2:
         qn = jnp.sum(q * q, axis=1, keepdims=True)
         yn = jnp.sum(y * y, axis=1)[None, :]
@@ -134,9 +147,11 @@ def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "l2", "sqrt", "bq", "bd", "bf16", "interpret"))
+    static_argnames=("k", "l2", "sqrt", "bq", "bd", "bf16", "qsplit",
+                     "interpret"))
 def _fused_knn(queries, db, k: int, l2: bool, sqrt: bool,
-               bq: int, bd: int, bf16: bool, interpret: bool):
+               bq: int, bd: int, bf16: bool, qsplit: bool,
+               interpret: bool):
     m, d = queries.shape
     n = db.shape[0]
     kp = round_up_safe(max(k, 1), _LANES)
@@ -150,7 +165,8 @@ def _fused_knn(queries, db, k: int, l2: bool, sqrt: bool,
     nb = np_ // bd
 
     kernel = functools.partial(
-        _fused_knn_kernel, k=k, kp=kp, bd=bd, n=n, l2=l2, bf16=bf16)
+        _fused_knn_kernel, k=k, kp=kp, bd=bd, n=n, l2=l2, bf16=bf16,
+        qsplit=qsplit)
     outd, outi = pl.pallas_call(
         kernel,
         grid=(mp // bq, nb),
@@ -361,6 +377,7 @@ def fused_knn_supported(m: int, n: int, d: int, k: int) -> bool:
 
 def fused_knn(queries, db, k: int, *, metric: str = "l2", sqrt: bool = False,
               bq: int = 256, bd: int = 0, bf16: bool = False,
+              qsplit: bool = False,
               interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
     """Fused exact kNN. ``metric`` is "l2" (squared L2, optionally sqrt'd)
     or "ip" (max inner product). ``bd=0`` picks the db tile from the db
@@ -385,4 +402,4 @@ def fused_knn(queries, db, k: int, *, metric: str = "l2", sqrt: bool = False,
     bd = min(bd, round_up_safe(db.shape[0], _LANES))
     bq = min(bq, round_up_safe(queries.shape[0], 8))
     return _fused_knn(queries, db, k, metric == "l2", sqrt, bq, bd, bf16,
-                      interpret)
+                      qsplit, interpret)

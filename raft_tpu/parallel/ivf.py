@@ -468,8 +468,18 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
 
         def cb(index):
             rows = range(*index[0].indices(n_shards))
-            return np.stack([shard_arrays(s)[key] for s in rows]
-                            ).astype(dtype, copy=False)
+            parts = []
+            for s in rows:
+                a = shard_arrays(s)[key]
+                # Every shard must match shard0's dtype — an astype here
+                # would silently truncate e.g. int64 ids from a mixed
+                # re-save down to shard0's int32 (the exact corruption
+                # validate_idx_dtype guards against).
+                expects(a.dtype == dtype,
+                        f"shard {s} {key} dtype {a.dtype} != shard0's "
+                        f"{dtype}")
+                parts.append(a)
+            return np.stack(parts)
 
         return jax.make_array_from_callback((n_shards,) + shape,
                                             sharding, cb)

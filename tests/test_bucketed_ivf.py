@@ -269,10 +269,17 @@ def test_pq_bucketed_decode_scan_matches_recon(rng, monkeypatch, kind):
         else pq.CodebookGen.PER_SUBSPACE)
     idx = pq.build(params, db)
     sp = pq.SearchParams(n_probes=8, engine="bucketed", bucket_cap=64)
+    # Pre-build the cache: PER_SUBSPACE would otherwise dispatch to the
+    # round-4 compressed-domain kernel tier (covered in
+    # test_pq_compressed.py) instead of the recon tier under test here.
+    idx.reconstructed()
     dr, ir = pq.search(sp, idx, Q, 5)        # recon path (small index)
     assert idx._recon is not None
     idx._recon = None
     monkeypatch.setattr(pq, "_RECON_AUTO_BYTES", 0)
+    # Keep the compressed-domain kernel out of the dispatch so the
+    # beyond-budget branch under test (block decode-scan) is exercised.
+    monkeypatch.setattr(pq, "_compressed_supported", lambda _i: False)
     dd, id_ = pq.search(sp, idx, Q, 5)       # decode path
     assert idx._recon is None                # never materialized the cache
     np.testing.assert_array_equal(np.asarray(ir), np.asarray(id_))

@@ -148,3 +148,36 @@ class TestKMeansBalanced:
         centroids, labels = kmeans_balanced.fit_predict(p, Xu, 4)
         assert centroids.dtype == np.float32
         assert len(np.unique(np.asarray(labels))) == 4
+
+
+class TestFindK:
+    """Binary-search auto-k (ref: detail/kmeans_auto_find_k.cuh) — the
+    objective peaks at the true cluster count on well-separated blobs and
+    the search runs O(log kmax) fits, not kmax."""
+
+    def test_finds_true_k_on_blobs(self, rng):
+        from raft_tpu.cluster import kmeans
+        from raft_tpu.random import make_blobs
+
+        X, _ = make_blobs(1200, 8, n_clusters=5, cluster_std=0.3, seed=3)
+        best_k, inertia, _ = kmeans.find_k(np.asarray(X), kmax=12, kmin=2,
+                                           max_iter=40)
+        assert 4 <= best_k <= 6, best_k
+        assert float(inertia) > 0
+
+    def test_log_number_of_fits(self, rng, monkeypatch):
+        from raft_tpu.cluster import kmeans
+        from raft_tpu.random import make_blobs
+
+        X, _ = make_blobs(600, 6, n_clusters=4, cluster_std=0.3, seed=1)
+        calls = []
+        orig = kmeans.fit
+
+        def counting_fit(p, data, *a, **kw):
+            calls.append(p.n_clusters)
+            return orig(p, data, *a, **kw)
+
+        monkeypatch.setattr(kmeans, "fit", counting_fit)
+        kmeans.find_k(np.asarray(X), kmax=32, kmin=2, max_iter=30)
+        # log2(32) ≈ 5 probe points (+ retries ≤ 3x each) vs 31 linear fits
+        assert len(calls) <= 3 * (2 + 5), len(calls)

@@ -213,7 +213,10 @@ class TestIvfPq:
         params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=5)
         index = ivf_pq.build(params, db[:3000])
         sp = ivf_pq.SearchParams(n_probes=16, engine="bucketed")
-        ivf_pq.search(sp, index, q, 10)          # populates _recon
+        # Opt into the recon tier (the round-4 compressed-domain kernel is
+        # otherwise the default bucketed tier and never builds the cache).
+        index.reconstructed()
+        ivf_pq.search(sp, index, q, 10)
         assert index._recon is not None
         index = ivf_pq.extend(index, db[3000:],
                               np.arange(3000, len(db), dtype=np.int32))
