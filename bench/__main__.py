@@ -172,8 +172,14 @@ def bench_sparse(rng, quick: bool):
 
     def make_csr(rows):
         nnz_row = max(1, int(d * density))
-        cols = rng.integers(0, d, size=(rows, nnz_row)).astype(np.int32)
-        cols = np.sort(cols, axis=1)
+        # Distinct sorted columns per row without a (rows, d) permutation:
+        # base + i*step (mod d) with an odd step is injective for i <
+        # d when d is a power of two (sampling with replacement would
+        # produce duplicate columns — malformed CSR).
+        base = rng.integers(0, d, size=(rows, 1))
+        step = rng.integers(0, d // 2, size=(rows, 1)) * 2 + 1
+        cols = ((base + np.arange(nnz_row)[None, :] * step) % d)
+        cols = np.sort(cols.astype(np.int32), axis=1)
         vals = rng.normal(size=(rows, nnz_row)).astype(np.float32)
         indptr = np.arange(rows + 1, dtype=np.int32) * nnz_row
         return CSR(jnp.asarray(indptr), jnp.asarray(cols.reshape(-1)),

@@ -311,6 +311,7 @@ def find_k(
     expects(kmax >= 2, "find_k needs kmax >= 2 (the Calinski-Harabasz "
             "objective is undefined at k=1; the reference's search floor "
             "is 2, kmeans_auto_find_k.cuh:111)")
+    expects(kmin <= kmax, f"kmin ({kmin}) must be <= kmax ({kmax})")
     left = max(kmin, 2)             # the objective needs k >= 2
     right = max(kmax, left)
     memo: dict = {}

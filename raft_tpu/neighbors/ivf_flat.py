@@ -676,6 +676,56 @@ def _invert_probe_map(probe_ids, n_lists: int, bucket_cap: int):
     return bucket, (sorted_lists, pos, keep, order)
 
 
+def _invert_probe_map_cells(probe_ids, n_lists: int, qrows: int):
+    """Invert (query → probed lists) into PACKED fixed-width query cells:
+    list l owns ``ceil(load_l / qrows)`` consecutive cells of ``qrows``
+    query slots each, so no (query, probe) pair is ever dropped and cell
+    rows are ≥ half full on average — vs the per-list bucket table whose
+    rows are mostly padding at skewed loads (the round-4 packing that
+    recovers the ~85% wasted kernel rows). Returns ``(cell_list
+    (max_cells,) int32 — the list each cell scans, -1 = unused, for the
+    kernel's scalar-prefetched block index map; bucket (max_cells,
+    qrows) query ids (-1 pad); route)`` where ``route`` feeds
+    :func:`_route_candidates_cells`. max_cells is static:
+    q·p // qrows + n_lists (one partial cell per list at worst)."""
+    q, p = probe_ids.shape
+    max_cells = (q * p) // qrows + n_lists
+    flat_lists = probe_ids.T.reshape(-1)                       # (p·q,)
+    flat_query = jnp.tile(jnp.arange(q, dtype=jnp.int32), p)
+    order = jnp.argsort(flat_lists, stable=True)
+    sorted_lists = flat_lists[order].astype(jnp.int32)
+    sorted_query = flat_query[order]
+    starts = jnp.searchsorted(sorted_lists,
+                              jnp.arange(n_lists, dtype=jnp.int32))
+    pos = jnp.arange(q * p, dtype=jnp.int32) - starts[sorted_lists]
+    loads = jnp.bincount(sorted_lists, length=n_lists)
+    n_cells = (loads + qrows - 1) // qrows
+    base_cell = jnp.cumsum(n_cells) - n_cells                  # exclusive
+    cell = base_cell[sorted_lists].astype(jnp.int32) + pos // qrows
+    slot = pos % qrows
+    bucket = (jnp.full((max_cells * qrows,), -1, jnp.int32)
+              .at[cell * qrows + slot].set(sorted_query)
+              .reshape(max_cells, qrows))
+    cell_list = (jnp.full((max_cells,), -1, jnp.int32)
+                 .at[cell].set(sorted_lists))
+    return cell_list, bucket, (cell, slot, order)
+
+
+def _route_candidates_cells(bd_, gi, route, q: int, p: int):
+    """Send each packed cell slot's top-kk candidates back to its query:
+    (q, p·kk) candidate rows for the final select_k (the cells analog of
+    :func:`_route_candidates`; nothing is dropped, so there is no keep
+    mask)."""
+    cell, slot, order = route
+    kk = bd_.shape[2]
+    cd = bd_[cell, slot]                                       # (p·q, kk)
+    ci = gi[cell, slot]
+    inv = jnp.argsort(order)
+    cd = cd[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
+    ci = ci[inv].reshape(p, q, kk).transpose(1, 0, 2).reshape(q, p * kk)
+    return cd, ci
+
+
 def _route_candidates(bd_, gi, route, q: int, p: int, bucket_cap: int,
                       worst):
     """Send each (list, slot) pair's top-kk candidates back to its query:

@@ -55,9 +55,11 @@ from raft_tpu.neighbors.ivf_flat import (
     _bucketed_probe_scan,
     _chunked_over_queries,
     _invert_probe_map,
+    _invert_probe_map_cells,
     _pack_lists,
     _pick_engine,
     _route_candidates,
+    _route_candidates_cells,
 )
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv, next_pow2
@@ -246,22 +248,31 @@ class Index:
 
     def compressed_scan_operands(self) -> tuple:
         """Cached operands of the compressed-domain Pallas scan
-        (ops/pq_scan.py): ``(codesT, abs_lo, abs_hi)`` — the transposed
-        packed codes (= codes size) and the per-list absolute codeword
-        tables (n_lists·rot_dim·max(B,128) f32, ~4× the codes at the
-        default config; far below the decompressed index). Rebuilt
-        lazily after extend(); PER_SUBSPACE + pq_bits∈{4,8} only."""
+        (ops/pq_scan.py): ``(codesT, abs_lo, abs_hi, invalid)`` — the
+        transposed packed codes (= codes size, pre-padded to the
+        kernel's group width so no per-search copy of the index is
+        made), the per-list absolute codeword tables
+        (n_lists·rot_dim·max(B,128) f32, ~4× the codes at the default
+        config; far below the decompressed index) and the padded
+        slot-validity mask. Rebuilt lazily after extend();
+        PER_SUBSPACE + pq_bits∈{4,8} only."""
         if self._scan_ops is None:
-            from raft_tpu.ops.pq_scan import (absolute_book_tables,
+            from raft_tpu.ops.pq_scan import (_SC, absolute_book_tables,
                                               permute_subspaces)
+            cap = self.pq_codes.shape[1]
+            capp = ceildiv(cap, _SC) * _SC
             codesT = jnp.swapaxes(self.pq_codes, 1, 2)
+            if capp != cap:
+                codesT = jnp.pad(codesT, ((0, 0), (0, 0), (0, capp - cap)))
+            invalid = (jnp.arange(capp, dtype=jnp.int32)[None, :]
+                       >= self.list_sizes[:, None])
             centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
                                      precision=lax.Precision.HIGHEST)
             crot_p = permute_subspaces(centers_rot, self.pq_dim,
                                        self.pq_bits)
             abs_lo, abs_hi = absolute_book_tables(self.pq_centers, crot_p,
                                                   self.pq_bits)
-            ops = (codesT, abs_lo, abs_hi)
+            ops = (codesT, abs_lo, abs_hi, invalid)
             if isinstance(codesT, jax.core.Tracer):
                 return ops
             object.__setattr__(self, "_scan_ops", ops)
@@ -431,6 +442,25 @@ def _bucketed_decode_scan(
     return select_k(cd, k, select_min=not is_ip, indices=ci)
 
 
+def _compressed_eligible(params: "SearchParams", index: Index,
+                         n_probes: int, k_pool: int, n_queries: int,
+                         default_dtypes: bool) -> bool:
+    """Single definition of the compressed-tier dispatch gate, shared by
+    :func:`search` and :func:`search_refined` (two re-spelled copies
+    would drift): supported config, no user recon cache, default score
+    dtypes, queue width within the kernel's cap, and — for
+    engine="auto" — a TPU backend with enough probe load to beat the
+    scan engine."""
+    if not (params.engine in ("auto", "bucketed")
+            and _compressed_supported(index) and index._recon is None
+            and default_dtypes and k_pool <= 128):
+        return False
+    if params.engine == "bucketed":
+        return True
+    load = n_queries * n_probes / max(index.n_lists, 1)
+    return jax.default_backend() == "tpu" and load >= 8
+
+
 def _compressed_supported(index: Index) -> bool:
     """The compressed-domain Pallas scan covers the default config family:
     per-subspace codebooks with byte-aligned code fields (pq_bits=8, or
@@ -442,39 +472,58 @@ def _compressed_supported(index: Index) -> bool:
                  or (index.pq_bits == 4 and index.pq_dim % 2 == 0)))
 
 
-def _compressed_bucketed_scan(rotq, index: Index, probe_ids, k: int,
-                              is_ip: bool, bucket_cap: int,
-                              interpret: bool):
-    """Bucketed search over the bit-packed codes via the compressed-domain
-    Pallas kernel (ops/pq_scan.py) — the ivf_pq_search.cuh:611 parity
-    tier: memory is the packed codes + the cached scan operands +
-    O(group) VMEM workspace (no decompressed index at any scale)."""
+# Query-slot width of one packed compressed-scan cell (rows per grid
+# cell; the matmul M-dim and select row count — see
+# _invert_probe_map_cells). Multiple of 8 (f32 sublane tile).
+_CELL_QROWS = 64
+
+
+@functools.partial(jax.jit,
+                   static_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
+                       indices, n_probes: int, k: int, is_ip: bool,
+                       J: int, bits: int, qrows: int,
+                       interpret: bool = False, cell_k: int = 0):
+    """The compressed-domain tier as ONE jitted program — coarse probe,
+    rotation, cells inversion, Pallas scan, routing and the final merge.
+    Eager op-by-op orchestration of the same pipeline measured 26×
+    slower over the axon link (433 ms vs 16.5 ms at the 100K shape);
+    index tensors ride as arguments so they are not baked into the HLO
+    (HTTP 413 over the remote-compile link otherwise).
+
+    ``cell_k`` < k bounds the per-(query, probe) queue at cell_k while
+    the final merge still keeps k of the pooled n_probes·cell_k
+    candidates — the over-retrieve mode of :func:`search_refined` (the
+    pool is a candidate set for exact re-ranking, so it need not be the
+    exact top-k; the in-kernel queue cost is linear in its k). 0 means
+    exact (cell_k = k)."""
     from raft_tpu.ops.pq_scan import permute_subspaces, pq_fused_scan
 
-    q = rotq.shape[0]
-    n_lists, cap, _ = index.pq_codes.shape
-    J, bits = index.pq_dim, index.pq_bits
+    q = Q.shape[0]
+    n_lists = centers.shape[0]
+    cell_k = cell_k or k
+    probe_ids = _select_clusters((Q, centers), n_probes, is_ip)
+    rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
 
-    bucket, route = _invert_probe_map(probe_ids, n_lists, bucket_cap)
+    cell_list, bucket, route = _invert_probe_map_cells(
+        probe_ids, n_lists, qrows)
     rotq_p = permute_subspaces(rotq, J, bits)
-    Qb = rotq_p[jnp.maximum(bucket, 0)]            # (n_lists, cap_q, d)
-    invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
-               >= index.list_sizes[:, None])
+    Qc = rotq_p[jnp.maximum(bucket, 0)]            # (max_cells, qrows, d)
 
-    codesT, abs_lo, abs_hi = index.compressed_scan_operands()
-    bd_, bi_ = pq_fused_scan(Qb, codesT, abs_lo, abs_hi, invalid, k, J,
-                             bits, is_ip, interpret)
-    gi = index.indices[jnp.arange(n_lists, dtype=jnp.int32)[:, None, None],
-                       jnp.maximum(bi_, 0)]
+    bd_, bi_ = pq_fused_scan(cell_list, Qc, codesT, abs_lo, abs_hi,
+                             invalid, cell_k, J, bits, is_ip, interpret)
+    gi = indices[jnp.maximum(cell_list, 0)[:, None, None],
+                 jnp.maximum(bi_, 0)]
     gi = jnp.where(bi_ < 0, -1, gi)
     # The kernel reports min-selection order for both metrics (negated
-    # inner products); route with +inf worst and undo the negation after.
-    cd, ci = _route_candidates(bd_, gi, route, q, probe_ids.shape[1],
-                               bucket_cap, jnp.inf)
+    # inner products); undo the negation after the final merge.
+    cd, ci = _route_candidates_cells(bd_, gi, route, q, n_probes)
     best_d, best_i = select_k(cd, k, select_min=True, indices=ci)
     if is_ip:
         best_d = -best_d
     return best_d, best_i
+
+
 
 
 def _as_float(x) -> jax.Array:
@@ -949,17 +998,37 @@ def search(
     k = min(k, max(index.capacity, 1))
     is_ip = index.metric == DistanceType.InnerProduct
 
-    probe_ids = _select_clusters((Q, index.centers), n_probes, is_ip)
-
-    rot = index.rotation_matrix
-    rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
-
     # "auto" only switches to the recon-cache engine when the LUT dtype
     # knobs are at their defaults — an explicit lut_dtype/internal dtype
     # request (fp16/bf16/uint8) is honored by the LUT scan path (an explicit
     # engine="bucketed" overrides, documented on SearchParams).
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
+    interpret = jax.default_backend() != "tpu"
+    # Compressed-domain tier dispatch, BEFORE the bucket-capacity
+    # machinery: the packed-cells kernel has no bucket table, so
+    # _pick_engine's measured capacity (one RTT-bound scalar readback)
+    # and its bucket-table memory fallback do not apply to it. Same
+    # static preconditions as _pick_engine's bucketed gate. A pre-built
+    # reconstruction cache (index.reconstructed()) opts into the recon
+    # tier below instead.
+    if _compressed_eligible(params, index, n_probes, k, Q.shape[0],
+                            default_dtypes):
+        codesT, abs_lo, abs_hi, invalid = index.compressed_scan_operands()
+        best_d, best_i = _compressed_search(
+            Q, index.centers, index.rotation_matrix, codesT, abs_lo,
+            abs_hi, invalid, index.indices, n_probes, k, is_ip,
+            index.pq_dim, index.pq_bits,
+            min(_CELL_QROWS, max(8, Q.shape[0])), interpret)
+        if index.metric == DistanceType.L2SqrtExpanded:
+            best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
+        return best_d, best_i
+
+    probe_ids = _select_clusters((Q, index.centers), n_probes, is_ip)
+
+    rot = index.rotation_matrix
+    rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
+
     engine, cap_q = _pick_engine(
         params.engine, Q.shape[0], n_probes, index.n_lists, k,
         params.bucket_cap, index.rot_dim, probe_ids,
@@ -968,18 +1037,6 @@ def search(
     if engine == "bucketed":
         recon_bytes = index.pq_codes.shape[0] * index.pq_codes.shape[1] \
             * index.rot_dim * 2
-        interpret = jax.default_backend() != "tpu"
-        if _compressed_supported(index) and index._recon is None:
-            # Default compressed-domain tier: the Pallas kernel scores the
-            # bit-packed codes directly (ivf_pq_search.cuh:611 parity) —
-            # no decompressed copy of the index at any scale. A
-            # pre-built reconstruction cache (index.reconstructed())
-            # opts into the recon tier below.
-            best_d, best_i = _compressed_bucketed_scan(
-                rotq, index, probe_ids, k, is_ip, cap_q, interpret)
-            if index.metric == DistanceType.L2SqrtExpanded:
-                best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
-            return best_d, best_i
         if index._recon is not None or recon_bytes <= _RECON_AUTO_BYTES:
             # Small index or a user-precomputed cache: score against the
             # resident bf16 reconstruction (fastest steady-state).
@@ -1024,6 +1081,59 @@ def search(
     if index.metric == DistanceType.L2SqrtExpanded:
         best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
     return best_d, best_i
+
+
+@traced
+def search_refined(
+    params: SearchParams, index: Index, dataset, queries, k: int,
+    refine_ratio: int = 2, handle=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Over-retrieve ``refine_ratio·k`` PQ candidates and exact-refine to
+    k against ``dataset`` — the reference's standard recipe for lifting
+    PQ recall past its quantization ceiling (neighbors/refine.cuh; the
+    recipe the reference's benches pair with ivf_pq, and the one that
+    clears the 0.86-class uniform-regime bar: plain 8-bit PQ saturates
+    near 0.83 there, see BASELINE.md). ``dataset`` is the original
+    row-major dataset the index was built over (the PQ index stores only
+    codes). Both stages run as jitted programs; the refine adds one
+    candidate gather + a (q, ratio·k, dim) exact distance batch.
+    Returns ``(distances, neighbors)`` like :func:`search`.
+    """
+    from raft_tpu.neighbors.refine import refine
+
+    expects(refine_ratio >= 1, "refine_ratio must be >= 1")
+    refine_ratio = int(refine_ratio)
+    if refine_ratio == 1:
+        return search(params, index, queries, k, handle=handle)
+
+    Q = _as_float(queries)
+    lut_dtype, internal_dtype = validate_search_dtypes(params)
+    default_dtypes = (lut_dtype == jnp.float32
+                      and internal_dtype == jnp.float32)
+    n_probes = min(params.n_probes, index.n_lists)
+    is_ip = index.metric == DistanceType.InnerProduct
+    # Same capacity clamp as search(): a tiny index degrades to fewer
+    # candidates instead of tripping refine's k <= n_candidates check.
+    k = min(k, max(index.capacity, 1))
+    pool = min(refine_ratio * k, max(index.capacity, 1))
+    # Compressed fast path with a bounded per-cell queue: the refine
+    # pool is a candidate set (exact re-rank follows), so each
+    # (query, probe) contributes its top-k only — the in-kernel queue
+    # cost stays that of k, not ratio·k (measured 6.1K → ~10K QPS at
+    # the 1M uniform config).
+    if (pool <= n_probes * k and Q.ndim == 2 and Q.shape[1] == index.dim
+            and _compressed_eligible(params, index, n_probes, pool,
+                                     Q.shape[0], default_dtypes)):
+        codesT, abs_lo, abs_hi, invalid = index.compressed_scan_operands()
+        _, i = _compressed_search(
+            Q, index.centers, index.rotation_matrix, codesT, abs_lo,
+            abs_hi, invalid, index.indices, n_probes, pool, is_ip,
+            index.pq_dim, index.pq_bits,
+            min(_CELL_QROWS, max(8, Q.shape[0])),
+            jax.default_backend() != "tpu", min(k, pool))
+    else:
+        _, i = search(params, index, queries, pool, handle=handle)
+    return refine(dataset, queries, i, k, metric=index.metric)
 
 
 # ---------------------------------------------------------------------------

@@ -12,6 +12,7 @@ needed; the gather + batched distance + top-k all fuse under jit.
 
 from __future__ import annotations
 
+import functools
 from typing import Tuple, Union
 
 import jax
@@ -38,7 +39,8 @@ def refine(
     cpp/src/neighbors/refine_*.cu; pylibraft neighbors/refine.pyx).
     Candidate id -1 (padding) is skipped like the reference's handling of
     invalid indices. Returns ``(distances (n_queries,k), indices
-    (n_queries,k))``.
+    (n_queries,k))``. Runs as one jitted program (gather + batched
+    distance + top-k) with the dataset as an argument.
     """
     metric = resolve_metric(metric)
     dataset = as_array(dataset)
@@ -50,7 +52,11 @@ def refine(
         dataset = dataset.astype(jnp.float32)
     if not jnp.issubdtype(queries.dtype, jnp.floating):
         queries = queries.astype(jnp.float32)
+    return _refine_core(dataset, queries, cand, k, metric)
 
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _refine_core(dataset, queries, cand, k: int, metric: DistanceType):
     invalid = cand < 0
     safe = jnp.where(invalid, 0, cand)
     gathered = dataset[safe]                      # (q, c, d)

@@ -101,13 +101,33 @@ def absolute_book_tables(pq_centers: jax.Array, centers_rot: jax.Array,
     return absT[:, :, :_LANES], absT[:, :, _LANES:]
 
 
-def _pq_scan_kernel(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
+def _pq_scan_kernel(cell_ref, rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
                     outd_ref, outi_ref, *, k: int, kp: int, cap: int,
                     J: int, L: int, B: int, pq_bits: int, is_ip: bool):
-    """One grid cell = one list: per 128-code chunk, gather-decode the
-    transposed absolute reconstruction from the list's codebook table,
-    score on the MXU, and fold grouped k-pass selects into a carried
-    best-k. Live VMEM is O(_SC)."""
+    """One grid cell = one packed query cell scanning one list (the
+    scalar-prefetched ``cell_ref`` maps cell → list for the block index
+    maps; -1 marks an unused tail cell, skipped entirely). Per 128-code
+    chunk, gather-decode the transposed absolute reconstruction from the
+    list's codebook table, score on the MXU, and fold grouped k-pass
+    selects into a carried best-k. Live VMEM is O(_SC)."""
+    b = pl.program_id(0)
+    used = cell_ref[b] >= 0
+
+    @pl.when(jnp.logical_not(used))
+    def _():
+        outd_ref[0] = jnp.full(outd_ref.shape[1:], jnp.inf, jnp.float32)
+        outi_ref[0] = jnp.full(outi_ref.shape[1:], -1, jnp.int32)
+
+    @pl.when(used)
+    def _():
+        _pq_scan_cell_body(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
+                           outd_ref, outi_ref, k=k, kp=kp, cap=cap, J=J,
+                           L=L, B=B, pq_bits=pq_bits, is_ip=is_ip)
+
+
+def _pq_scan_cell_body(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
+                       outd_ref, outi_ref, *, k: int, kp: int, cap: int,
+                       J: int, L: int, B: int, pq_bits: int, is_ip: bool):
     rotq = rotq_ref[0]                              # (bq, rot) f32
     bq, rot = rotq.shape
     rqb = rotq.astype(jnp.bfloat16)
@@ -167,69 +187,78 @@ def _pq_scan_kernel(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("k", "J", "pq_bits", "is_ip", "interpret"))
-def pq_fused_scan(rotq_b, codesT, abs_lo, abs_hi, invalid, k: int,
-                  J: int, pq_bits: int, is_ip: bool,
+def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
+                  k: int, J: int, pq_bits: int, is_ip: bool,
                   interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Batched compressed-domain PQ scan.
+    """Batched compressed-domain PQ scan over PACKED query cells.
 
-    rotq_b: (n_lists, bq, rot_dim) f32 — per-list query buckets, already
-    in the kernel's permuted subspace order (see permute_subspaces).
+    cell_list: (max_cells,) int32 — the list each cell scans (-1 =
+    unused; see ivf_flat._invert_probe_map_cells), prefetched so the
+    kernel's block index maps can stream each cell's list operands.
+    rotq_cells: (max_cells, qrows, rot_dim) f32 query rows per cell,
+    already in the kernel's permuted subspace order (permute_subspaces).
     codesT: (n_lists, nbytes, cap) u8 transposed packed rows. abs_lo /
     abs_hi: (n_lists, rot_dim, 128) f32 absolute codeword tables
     (absolute_book_tables). invalid: (n_lists, cap) bool. Returns
-    (distances (n_lists, bq, k), local slot ids). L2 metrics report
+    (distances (max_cells, qrows, k), local slot ids). L2 metrics report
     squared distances of the bf16-scored reconstruction (like the
     recon-cache engine); is_ip reports negated inner products
     (min-select order).
     """
-    n_lists, bq, rot_dim = rotq_b.shape
+    max_cells, qrows, rot_dim = rotq_cells.shape
     nbytes, cap = codesT.shape[1], codesT.shape[2]
     B = 1 << pq_bits
     L = rot_dim // J
     kp = round_up_safe(max(k, 1), _LANES)
     capp = round_up_safe(cap, _SC)
-    bqp = round_up_safe(bq, 8)
+    qr = round_up_safe(qrows, 8)
     if capp != cap:
         codesT = jnp.pad(codesT, ((0, 0), (0, 0), (0, capp - cap)))
         invalid = jnp.pad(invalid, ((0, 0), (0, capp - cap)),
                           constant_values=True)
-    if bqp != bq:
-        rotq_b = jnp.pad(rotq_b, ((0, 0), (0, bqp - bq), (0, 0)))
+    if qr != qrows:
+        rotq_cells = jnp.pad(rotq_cells, ((0, 0), (0, qr - qrows), (0, 0)))
 
     kernel = functools.partial(
         _pq_scan_kernel, k=k, kp=kp, cap=capp, J=J, L=L, B=B,
         pq_bits=pq_bits, is_ip=is_ip)
-    outd, outi = pl.pallas_call(
-        kernel,
-        grid=(n_lists,),
+
+    def by_list(b, cl):
+        return (jnp.maximum(cl[b], 0), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(max_cells,),
         in_specs=[
-            pl.BlockSpec((1, bqp, rot_dim), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, qr, rot_dim), lambda b, cl: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nbytes, capp), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, nbytes, capp), by_list,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, rot_dim, _LANES), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, rot_dim, _LANES), by_list,
                          memory_space=pltpu.VMEM),
             # hi half of the code axis — a 1-row dummy when B <= 128
             # (the kernel statically never reads it).
-            pl.BlockSpec((1, abs_hi.shape[1], _LANES), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, abs_hi.shape[1], _LANES), by_list,
                          memory_space=pltpu.VMEM),
             # A middle unit axis keeps the mask block's trailing two dims
             # (1, capp) legal for the mosaic lowering (see fused_knn).
-            pl.BlockSpec((1, 1, capp), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, 1, capp), by_list,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, bqp, kp), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, qr, kp), lambda b, cl: (b, 0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, bqp, kp), lambda b: (b, 0, 0),
+            pl.BlockSpec((1, qr, kp), lambda b, cl: (b, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
+    )
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((n_lists, bqp, kp), jnp.float32),
-            jax.ShapeDtypeStruct((n_lists, bqp, kp), jnp.int32),
+            jax.ShapeDtypeStruct((max_cells, qr, kp), jnp.float32),
+            jax.ShapeDtypeStruct((max_cells, qr, kp), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",)),
         interpret=interpret,
-    )(rotq_b, codesT, abs_lo, abs_hi, invalid[:, None, :])
-    return outd[:, :bq, :k], outi[:, :bq, :k]
+    )(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid[:, None, :])
+    return outd[:, :qrows, :k], outi[:, :qrows, :k]

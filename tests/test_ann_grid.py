@@ -31,9 +31,9 @@ N_PROBES = 20         # ivf_pq::search_params default
 
 def _data(dtype):
     rng = np.random.default_rng(42)
-    if dtype == np.float32:
-        db = rng.uniform(0.1, 2.0, (N_DB, DIM)).astype(np.float32)
-        q = rng.uniform(0.1, 2.0, (N_QUERIES, DIM)).astype(np.float32)
+    if dtype in (np.float32, np.float16):
+        db = rng.uniform(0.1, 2.0, (N_DB, DIM)).astype(dtype)
+        q = rng.uniform(0.1, 2.0, (N_QUERIES, DIM)).astype(dtype)
     else:
         db = rng.integers(1, 21, (N_DB, DIM)).astype(dtype)
         q = rng.integers(1, 21, (N_QUERIES, DIM)).astype(dtype)
@@ -158,11 +158,28 @@ class TestIvfPqIntDtypes:
         assert rec >= bound, (rec, bound)
 
 
-class TestIvfFlatGrid:
-    """min_recall = nprobe/nlist (ann_ivf_flat.cuh:111) per dtype."""
+class TestIvfPqHalfInput:
+    """float16 inputs (the reference's half typed shards,
+    ann_ivf_pq/test_float_int64_t.cu siblings): same 0.86-class threshold
+    as f32 — f16 inputs are exact in the f32 training pipeline for this
+    value range."""
 
-    @pytest.mark.parametrize("dtype", [np.float32, np.uint8, np.int8],
-                             ids=["float32", "uint8", "int8"])
+    def test_half_input_recall(self):
+        db, q = _data(np.float16)
+        gt_d, gt_i = _ground_truth(db, q, DistanceType.L2Expanded)
+        d, i = _run_pq(db.astype(np.float32), q, DistanceType.L2Expanded,
+                       {}, {})
+        rec = _recall_with_ties(i, d, gt_i, gt_d, select_min=True)
+        assert rec >= 0.86, rec
+
+
+class TestIvfFlatGrid:
+    """min_recall = nprobe/nlist (ann_ivf_flat.cuh:111) per dtype
+    {float, half, int8, uint8} — the reference's typed-shard matrix."""
+
+    @pytest.mark.parametrize("dtype",
+                             [np.float32, np.float16, np.uint8, np.int8],
+                             ids=["float32", "float16", "uint8", "int8"])
     @pytest.mark.parametrize("n_probes", [8, 16, 32])
     def test_flat_recall_bound(self, dtype, n_probes):
         db, q = _data(dtype)
