@@ -56,10 +56,11 @@ def _spread(st):
                  * 100, 1)
 
 
-def _eager_qps(fn, q, n_queries=1000, reps=16):
+def _eager_qps(fn, q, reps=16):
     """Pipelined eager dispatch + one fence per round, RTT-corrected —
     the shared timing protocol of the 1M/4M/SIFT families (a 1M search
-    wrapped in a measurement lax.scan crashes the axon worker)."""
+    wrapped in a measurement lax.scan crashes the axon worker). QPS is
+    per row of ``q``."""
     from bench.common import fence, link_rtt
 
     out = fn(q)
@@ -72,7 +73,7 @@ def _eager_qps(fn, q, n_queries=1000, reps=16):
         fence(out)
         times.append((time.perf_counter() - t0 - link_rtt()) / reps)
     times.sort()
-    return n_queries / np.median(times), \
+    return q.shape[0] / np.median(times), \
         (times[-1] - times[0]) / np.median(times) * 100
 
 
@@ -186,13 +187,38 @@ def _family():
           v / _R1["ivf_pq_search_100k_qps"], spread_pct=_spread(st))
     del fidx, pidx, X, Q, recon
 
-    # -- balanced k-means fit (wall; vs_baseline = speedup r1/now)
+    # -- fused_l2_nn acceptance shape (VERDICT r4 item 3: >=15% MFU at
+    # 8192x4096x128-class shapes, spread <=15%) — the Pallas kernel path.
+    x = jnp.asarray(rng.normal(size=(8192, 128)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(4096, 128)).astype(np.float32))
+    st = scan_stats(lambda q: fused_l2_nn_min_reduce(q, y), x)
+    s = st["median_s"]
+    flops = 2.0 * 8192 * 4096 * 128 / s
+    _emit("fused_l2_nn_8192x4096x128_rows", 8192 / s, "rows/s", 1.0,
+          spread_pct=_spread(st), flops_t=flops / 1e12,
+          mfu_pct=round(flops / _BF16_PEAK * 100, 2))
+
+    # -- balanced k-means fit: fence-timed wall (block_until_ready does
+    # not fence on axon — wall_stats under-measured with 100%+ spread,
+    # VERDICT r3 weak #5; vs_baseline = speedup r1/now)
+    from bench.common import fence
+
     Xk, _ = make_blobs(100_000, 64, n_clusters=100, seed=7)
     p = KMeansBalancedParams(n_iters=10)
-    st = wall_stats(lambda: kmeans_balanced.fit(p, Xk, 512), repeats=5)
-    _emit("kmeans_balanced_fit_100k_s", st["median_s"], "s",
-          _R1["kmeans_balanced_fit_100k_s"] / st["median_s"],
-          spread_pct=_spread(st))
+    for _ in range(2):                          # compile + steady-state
+        c = kmeans_balanced.fit(p, Xk, 512)     # warm (the first timed
+        fence(c)                                # fit after compile still
+    fits = []                                   # carries a ~2x outlier)
+    for _ in range(5):
+        t0 = time.perf_counter()
+        c = kmeans_balanced.fit(p, Xk, 512)
+        fence(c)
+        fits.append(time.perf_counter() - t0)
+    fits.sort()
+    med = float(np.median(fits))
+    _emit("kmeans_balanced_fit_100k_s", med, "s",
+          _R1["kmeans_balanced_fit_100k_s"] / med,
+          spread_pct=round((fits[-1] - fits[0]) / med * 100, 1))
     del Xk
 
     # -- sparse pairwise L2 at 50K dims (block-staged engine)

@@ -68,10 +68,12 @@ def _labels(X, centroids, metric: DistanceType) -> jax.Array:
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
         _, labels = fused_l2_nn_min_reduce(X, centroids)
         return labels
-    from raft_tpu.distance.distance_types import is_min_close
+    from raft_tpu.distance.distance_types import value_form_select_min
 
+    # pairwise emits distance form for cosine/correlation (1 - sim), so
+    # polarity follows the VALUE form, not the reference's kernel form.
     d = pairwise_distance_fn(X, centroids, metric=metric)
-    return (jnp.argmin(d, axis=1) if is_min_close(metric)
+    return (jnp.argmin(d, axis=1) if value_form_select_min(metric)
             else jnp.argmax(d, axis=1)).astype(jnp.int32)
 
 

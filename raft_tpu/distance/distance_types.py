@@ -40,13 +40,27 @@ def is_min_close(metric: DistanceType) -> bool:
     """Whether smaller values mean closer neighbors.
 
     Ref: distance/distance_types.hpp:72-87 — similarity metrics
-    (InnerProduct, Cosine, Correlation) select max.
+    (InnerProduct, Cosine, Correlation) select max. NOTE: the
+    reference's kNN kernels emit similarity form for cosine/correlation
+    to match this polarity; THIS library's pairwise outputs are distance
+    form (1 − similarity) for them, so selection over pairwise-form
+    values must use :func:`value_form_select_min` instead (pairing this
+    function with pairwise-form values returns the *farthest* rows).
     """
     return metric not in (
         DistanceType.InnerProduct,
         DistanceType.CosineExpanded,
         DistanceType.CorrelationExpanded,
     )
+
+
+def value_form_select_min(metric: DistanceType) -> bool:
+    """Selection polarity for values in this library's pairwise-distance
+    form: every metric emits distances — including ``1 − similarity``
+    for cosine/correlation — except InnerProduct, which scores raw
+    similarity (larger = closer). See the note on :func:`is_min_close`.
+    """
+    return metric != DistanceType.InnerProduct
 
 
 # Metric-name → DistanceType map, identical to pylibraft's DISTANCE_TYPES

@@ -31,7 +31,8 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array, validate_idx_dtype
-from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.distance.distance_types import (
+    DistanceType, resolve_metric, value_form_select_min)
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.util.pow2 import ceildiv
@@ -170,21 +171,21 @@ def tiled_brute_force_knn(
     n = db.shape[0]
     if n <= tile_db:
         dmat = pairwise_distance_fn(queries, db, metric=metric, metric_arg=metric_arg)
-        return select_k(dmat, k, select_min=is_min_close(metric))
+        return select_k(dmat, k, select_min=value_form_select_min(metric))
     # Host loop over tiles with running merge (build-time friendly; the
     # per-tile pairwise itself is jit-compiled).
     best_d = best_i = None
     for start in range(0, n, tile_db):
         tile = db[start : start + tile_db]
         dt = pairwise_distance_fn(queries, tile, metric=metric, metric_arg=metric_arg)
-        sd, si = select_k(dt, min(k, tile.shape[0]), select_min=is_min_close(metric))
+        sd, si = select_k(dt, min(k, tile.shape[0]), select_min=value_form_select_min(metric))
         si = si + start
         if best_d is None:
             best_d, best_i = sd, si
         else:
             cat_d = jnp.concatenate([best_d, sd], axis=1)
             cat_i = jnp.concatenate([best_i, si], axis=1)
-            best_d, pos = select_k(cat_d, k, select_min=is_min_close(metric))
+            best_d, pos = select_k(cat_d, k, select_min=value_form_select_min(metric))
             best_i = jnp.take_along_axis(cat_i, pos, axis=1)
     return best_d, best_i
 
@@ -275,7 +276,7 @@ def knn(
         pi = pi.astype(idx_dtype)
         kk = pd.shape[1]
         if kk < k:  # pad small parts so merge shapes agree
-            worst = jnp.inf if is_min_close(metric) else -jnp.inf
+            worst = jnp.inf if value_form_select_min(metric) else -jnp.inf
             pd = jnp.concatenate(
                 [pd, jnp.full((pd.shape[0], k - kk), worst, pd.dtype)], axis=1)
             pi = jnp.concatenate(
@@ -286,7 +287,7 @@ def knn(
         base += p.shape[0]
     keys = jnp.stack(all_d)
     vals = jnp.stack(all_i)
-    return knn_merge_parts(keys, vals, select_min=is_min_close(metric),
+    return knn_merge_parts(keys, vals, select_min=value_form_select_min(metric),
                            translations=offsets)
 
 

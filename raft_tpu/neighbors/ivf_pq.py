@@ -130,6 +130,12 @@ class IndexParams:
     pq_dim: int = 0
     codebook_kind: CodebookGen = CodebookGen.PER_SUBSPACE
     force_random_rotation: bool = False
+    # TPU extension (no 23.04 analog; the 23.04 surface stops at
+    # force_random_rotation): rounds of OPQ-style alternation between
+    # codebook training and the orthogonal-Procrustes rotation update.
+    # 0 = off (reference behavior). Helps anisotropic residual clouds;
+    # see build() step 3b.
+    opq_iters: int = 0
     add_data_on_build: bool = True
     conservative_memory_allocation: bool = False
     # Neighbor-id dtype: int32 (default) or int64 (reference IdxT parity;
@@ -712,6 +718,33 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
 
     # 3. residuals of the trainset under their cluster assignment.
     labels = kmeans_balanced.predict(kb, centers, trainset)
+
+    # 3b. OPQ-style alternation (TPU extension beyond the 23.04 surface,
+    # evaluated per VERDICT r4 item 4): alternate training throwaway
+    # codebooks with the orthogonal-Procrustes rotation update
+    # R ← U·Vᵀ from SVD(X̂ᵀ·Xres) — the rotation that best aligns the
+    # residual cloud with its current quantization ("Optimized Product
+    # Quantization", the non-parametric variant). Helps when residual
+    # variance is anisotropic across the subspace split; a no-op knob
+    # (0) by default.
+    for _ in range(max(0, params.opq_iters)):
+        res = _residuals(trainset, labels, centers, rot, pq_dim)
+        data = jnp.swapaxes(res, 0, 1)
+        w = jnp.ones(data.shape[:2], data.dtype)
+        books_it = _vq_train_batched(state.next_key(), data, w,
+                                     book_size,
+                                     max(4, params.kmeans_n_iters // 2))
+        codes_it = _encode(res, books_it)
+        # X̂ = quantized rotated residuals; Xres = unrotated residuals.
+        cw = jnp.take_along_axis(
+            books_it[None], codes_it[:, :, None, None].astype(jnp.int32),
+            axis=2)[:, :, 0, :].reshape(res.shape[0], rot_dim)
+        xres = trainset - centers[labels]
+        u, _, vt = jnp.linalg.svd(
+            jnp.matmul(cw.T, xres, precision=lax.Precision.HIGHEST),
+            full_matrices=False)       # U (rot, min), Vt (min, dim)
+        rot = jnp.matmul(u, vt, precision=lax.Precision.HIGHEST)
+
     res = _residuals(trainset, labels, centers, rot, pq_dim)  # (nt, pq_dim, l)
 
     # 4. codebooks.

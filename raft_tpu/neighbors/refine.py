@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
-from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
+from raft_tpu.distance.distance_types import (
+    DistanceType, resolve_metric, value_form_select_min)
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.core.nvtx import traced
 
@@ -78,7 +79,7 @@ def _refine_core(dataset, queries, cand, k: int, metric: DistanceType):
     else:
         raise ValueError(f"refine: unsupported metric {metric!r}")
 
-    select_min = is_min_close(metric)
+    select_min = value_form_select_min(metric)
     worst = jnp.inf if select_min else -jnp.inf
     d = jnp.where(invalid, worst, d)
     dist, pos = select_k(d, k, select_min=select_min)

@@ -43,8 +43,8 @@ from jax import lax
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.logger import logger
-from raft_tpu.distance.distance_types import (DistanceType, is_min_close,
-                                              resolve_metric)
+from raft_tpu.distance.distance_types import (
+    DistanceType, resolve_metric, value_form_select_min)
 from raft_tpu.distance.pairwise import distance as dense_distance
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.sparse.types import CSR
@@ -332,7 +332,7 @@ def _x_knn_body(metric: DistanceType, p: float, d: int, dc: int, b: int,
     never materializes more than (b, k + b) candidates. ``bases`` carries
     each y block's global row offset (y blocks may arrive nnz-grouped,
     out of id order)."""
-    select_min = is_min_close(metric)
+    select_min = _knn_select_min(metric)
     worst = jnp.inf if select_min else -jnp.inf
     dpad = ceildiv(d, dc) * dc if metric in _EW_METRICS else d
     X = _stage(xr, xc, xv, b, d, dpad)
@@ -455,6 +455,19 @@ def pairwise_distance(
     return jnp.concatenate(row_parts, axis=0)[:m, :n]
 
 
+def _knn_select_min(metric: DistanceType) -> bool:
+    """Selection polarity for the VALUE FORM this engine's epilogues emit:
+    every metric is distance form — including 1 - similarity for
+    cosine/correlation (_gram_epilogue, matching the reference's
+    *pairwise* outputs) — except InnerProduct, which scores raw
+    similarity. The reference's ``is_min_close`` instead treats
+    cosine/correlation as similarities because its sparse kNN kernels
+    emit similarity form (sparse/spatial/detail/knn.cuh:362); pairing
+    that polarity with our distance-form values returned the FARTHEST
+    rows (round-4 review catch)."""
+    return value_form_select_min(metric)
+
+
 # Budget for the dense query-side staging of the x-dense kNN fast path.
 _XDENSE_BYTES = 512 * 1024 * 1024
 
@@ -469,7 +482,7 @@ def _scan_knn_xdense(metric: DistanceType, d: int, b: int, k: int, n: int,
     small matmuls instead (measured 2.9 s vs 1.0 s warm at the
     2048-query 100K×50K shape). Gram metrics only; the query side must
     fit the _XDENSE_BYTES staging budget."""
-    select_min = is_min_close(metric)
+    select_min = _knn_select_min(metric)
     worst = jnp.inf if select_min else -jnp.inf
     m = X.shape[0]
 
@@ -516,7 +529,7 @@ def knn_blocked(
     if (max(m, n) * d * 4 <= _DENSE_BYTES) or metric == DistanceType.Haversine:
         dmat = dense_distance(query.to_dense(), idx.to_dense(), metric=metric,
                               metric_arg=metric_arg)
-        return select_k(dmat, k, select_min=is_min_close(metric))
+        return select_k(dmat, k, select_min=_knn_select_min(metric))
 
     b = _pick_block(max(m, n), d, metric in _EW_METRICS)
     dc = _pick_dchunk(d, b) if metric in _EW_METRICS else d
@@ -549,14 +562,15 @@ def knn_blocked(
             return parts_d[0], parts_i[0]
         cd = jnp.concatenate(parts_d, axis=1)
         ci = jnp.concatenate(parts_i, axis=1)
-        return select_k(cd, k, select_min=is_min_close(metric), indices=ci)
+        return select_k(cd, k, select_min=_knn_select_min(metric),
+                        indices=ci)
 
     xpack, xnnz = _block_pad_csr(query, b)
     ypack, ynnz = _block_pad_csr(idx, b)
     xgroups = _nnz_groups(xnnz)
     ygroups = _nnz_groups(ynnz)
     p = float(metric_arg)
-    select_min = is_min_close(metric)
+    select_min = _knn_select_min(metric)
 
     row_d = [None] * xpack[0].shape[0]
     row_i = [None] * xpack[0].shape[0]

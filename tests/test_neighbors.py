@@ -360,3 +360,44 @@ class TestIvfFlatQuantized:
         d, i = ivf_flat.search(ivf_flat.SearchParams(n_probes=16), idx, q, 5)
         _, truth = _naive_knn(q, db, 5)
         assert _recall(np.asarray(i), truth) > 0.9
+
+
+def test_brute_force_cosine_polarity(rng):
+    """Cosine/correlation brute-force kNN must return the NEAREST rows
+    (pairwise emits 1 - similarity distance form; round-4 review catch:
+    pairing the reference's similarity-form polarity with our
+    distance-form values returned the farthest rows)."""
+    from raft_tpu.distance.distance_types import DistanceType
+
+    a = rng.standard_normal((200, 32)).astype(np.float32)
+    q = rng.standard_normal((10, 32)).astype(np.float32)
+    an = a / np.linalg.norm(a, axis=1, keepdims=True)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    for metric in (DistanceType.CosineExpanded,
+                   DistanceType.CorrelationExpanded):
+        d, i = brute_force.knn(a, q, 5, metric=metric)
+        if metric == DistanceType.CosineExpanded:
+            dm = 1.0 - qn @ an.T
+        else:
+            ac = a - a.mean(1, keepdims=True)
+            qc = q - q.mean(1, keepdims=True)
+            dm = 1.0 - (qc / np.linalg.norm(qc, axis=1, keepdims=True)) @ (
+                ac / np.linalg.norm(ac, axis=1, keepdims=True)).T
+        ref = np.sort(dm, axis=1)[:, :5]
+        np.testing.assert_allclose(np.sort(np.asarray(d), 1), ref,
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_refine_cosine_polarity(rng):
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.neighbors.refine import refine
+
+    db = rng.standard_normal((500, 16)).astype(np.float32)
+    q = rng.standard_normal((20, 16)).astype(np.float32)
+    cand = np.broadcast_to(np.arange(50, dtype=np.int32), (20, 50)).copy()
+    d, i = refine(db, q, cand, 5, metric=DistanceType.CosineExpanded)
+    qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+    cn = db[:50] / np.linalg.norm(db[:50], axis=1, keepdims=True)
+    ref = np.sort(1.0 - qn @ cn.T, axis=1)[:, :5]
+    np.testing.assert_allclose(np.sort(np.asarray(d), 1), ref,
+                               rtol=1e-3, atol=1e-3)

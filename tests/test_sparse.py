@@ -326,3 +326,38 @@ class TestSolvers:
         for j in range(3):
             u = np.asarray(U)[:, j]
             assert np.linalg.norm(dense @ u - w[j] * u) < 1e-2
+
+
+def test_sparse_knn_cosine_polarity(rng):
+    """Cosine/correlation sparse kNN must return the NEAREST rows: the
+    engine's epilogues emit distance form (1 - similarity), so selection
+    is min-side for them — pairing the reference's similarity-form
+    polarity with distance-form values returned the farthest rows
+    (round-4 review catch)."""
+    from raft_tpu.distance.distance_types import DistanceType
+    from raft_tpu.sparse import distance as spd
+    from raft_tpu.sparse.types import csr_from_dense
+
+    a = rng.standard_normal((300, 700)).astype(np.float32)
+    a[np.abs(a) < 1.2] = 0
+    q = rng.standard_normal((37, 700)).astype(np.float32)
+    q[np.abs(q) < 1.2] = 0
+    q[:, 0] = 1.0  # no all-zero query rows
+    a[:, 0] = 1.0
+    for metric in (DistanceType.CosineExpanded,
+                   DistanceType.CorrelationExpanded):
+        monkey_budget = 0
+        import raft_tpu.sparse.distance as sd
+        old = sd._DENSE_BYTES
+        sd._DENSE_BYTES = monkey_budget     # force the blocked engine
+        try:
+            d, i = spd.knn_blocked(csr_from_dense(a), csr_from_dense(q), 5,
+                                   metric=metric)
+        finally:
+            sd._DENSE_BYTES = old
+        dm = np.asarray(spd.pairwise_distance(csr_from_dense(q),
+                                              csr_from_dense(a),
+                                              metric=metric))
+        ref = np.sort(dm, axis=1)[:, :5]
+        np.testing.assert_allclose(np.sort(np.asarray(d), 1), ref,
+                                   rtol=1e-4, atol=1e-4)
