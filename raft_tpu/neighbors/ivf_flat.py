@@ -43,7 +43,7 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType, is_min_close, resolve_metric
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.random.rng_state import RngState
-from raft_tpu.util.pow2 import ceildiv, next_pow2
+from raft_tpu.util.pow2 import ceildiv, next_pow2, round_up_safe
 from raft_tpu.core.nvtx import traced
 
 
@@ -74,34 +74,31 @@ class SearchParams:
     analogous decomposition inside the kernel launch instead):
 
     ``engine``: "auto" | "scan" | "bucketed". "scan" is the per-query
-    gather path (exact probe coverage). "bucketed" inverts the probe map —
-    per list, the queries probing it are batched and scored with one MXU
-    matmul (the query-grouping of calc_chunk_indices,
-    detail/ivf_pq_search.cuh:267, turned into dense tiles). When a list is
-    probed by more than ``bucket_cap`` queries, the excess (query, probe)
-    pairs are dropped best-centroid-rank-kept *per list* — under hot-list
-    contention an explicit low capacity can therefore cost a query even
-    its best-ranked probe. "auto" sizes the capacity from the measured
-    best-half-rank contention (one jitted scalar device read), bounded at
-    8× the mean probe load: below the bound only rank ≥ n_probes/2
-    probes of contended lists ever drop; when hot-list skew pushes the
-    drop-free capacity past the bound, auto caps there — floored at the
-    measured rank-0 contention, so a query's single best probe never
-    drops — and deeper-rank probes of the hot lists may then drop
-    (measured recall-neutral at 1M while 4-5× faster than drop-free
-    sizing). Auto falls back to "scan" when
-    the capacity would exceed the bucket memory budget, and picks
-    bucketed on TPU when the probe load q·n_probes/n_lists is high
-    enough to fill tiles.
+    gather path (exact probe coverage). "bucketed" inverts the probe map
+    into per-list MXU work (the query-grouping of calc_chunk_indices,
+    detail/ivf_pq_search.cuh:267, turned into dense tiles). Since round
+    4 it resolves to the PACKED-CELLS tier whenever k ≤ 128 and one
+    list's data block fits the VMEM budget: fixed-width query cells (hot
+    lists own several), no (query, probe) pair ever dropped, no
+    capacity measurement, fully traceable under jit — ``bucket_cap`` is
+    ignored on that tier. "auto" picks it on TPU when the probe load
+    q·n_probes/n_lists is high enough to fill tiles.
 
-    ``bucket_cap``: per-list query-slot capacity for "bucketed"; 0 = the
-    measured sizing above. Set explicitly to skip the measurement and
-    accept drops at that capacity. Under an outer ``jit`` the measurement
-    is impossible (abstract probe map): auto falls back to "scan", and
-    explicit "bucketed" requires an explicit bucket_cap. The measured
-    capacity is memoized on the index per query-batch shape, so a
-    steady-state query loop pays the measurement readback once;
-    ``extend`` invalidates the memo.
+    Only when the cells tier is unavailable (k > 128 or oversized list
+    blocks) does "bucketed" fall back to the legacy bucket-table engine,
+    where ``bucket_cap`` applies: a list probed by more than
+    ``bucket_cap`` queries drops the excess pairs best-centroid-rank-
+    kept per list; "auto" then sizes the capacity from the measured
+    best-half-rank contention (one jitted scalar device read), bounded
+    at 8× the mean probe load and floored at the rank-0 contention (a
+    query's single best probe never drops), falling back to "scan" when
+    the capacity would exceed the bucket memory budget.
+
+    ``bucket_cap``: legacy-tier per-list query-slot capacity; 0 = the
+    measured sizing above (memoized on the index per query-batch shape;
+    ``extend`` invalidates the memo). Under an outer ``jit`` the
+    legacy-tier measurement is impossible: auto falls back to "scan" and
+    explicit "bucketed" requires an explicit bucket_cap there.
     """
 
     n_probes: int = 20
@@ -743,6 +740,48 @@ def _route_candidates(bd_, gi, route, q: int, p: int, bucket_cap: int,
     return cd, ci
 
 
+# Query-slot width of one packed cell (see _invert_probe_map_cells) and
+# the VMEM budget for one list's data block in the cells kernel.
+_CELL_QROWS = 64
+_CELL_DB_BYTES = 6 * 1024 * 1024
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
+                  k: int, inner_is_l2: bool, sqrt: bool, qrows: int,
+                  qsplit: bool, interpret: bool = False):
+    """IVF-Flat search over packed query cells as ONE jitted program —
+    coarse probe, cells inversion, fused Pallas scan, routing and the
+    final merge (the round-4 engine treatment applied to IVF-Flat: no
+    bucket-capacity measurement, no probe drops, no eager glue)."""
+    from raft_tpu.ops.fused_knn import fused_cells_knn
+
+    q = Q.shape[0]
+    n_lists, cap, _ = data.shape
+    probe_ids = _coarse_probe(Q, centers, n_probes, inner_is_l2)
+    cell_list, bucket, route = _invert_probe_map_cells(
+        probe_ids, n_lists, qrows)
+    Qc = Q[jnp.maximum(bucket, 0)]                 # (max_cells, qrows, d)
+    invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+               >= list_sizes[:, None])
+    bd_, bi_ = fused_cells_knn(cell_list, Qc, data, invalid, k,
+                               l2=inner_is_l2,
+                               bf16=data.dtype == jnp.bfloat16,
+                               qsplit=qsplit, interpret=interpret)
+    gi = indices[jnp.maximum(cell_list, 0)[:, None, None],
+                 jnp.maximum(bi_, 0)]
+    gi = jnp.where(bi_ < 0, -1, gi)
+    # The kernel reports min-selection order (ip scores negated).
+    cd, ci = _route_candidates_cells(bd_, gi, route, q, n_probes)
+    best_d, best_i = select_k(cd, k, select_min=True, indices=ci)
+    if inner_is_l2:
+        if sqrt:
+            best_d = jnp.sqrt(best_d)
+    else:
+        best_d = -best_d
+    return best_d, best_i
+
+
 @traced
 def search(
     params: SearchParams, index: Index, queries, k: int,
@@ -765,10 +804,6 @@ def search(
     inner_is_l2 = metric != DistanceType.InnerProduct
     sqrt = metric in (DistanceType.L2SqrtExpanded, DistanceType.L2SqrtUnexpanded)
 
-    # Coarse quantizer: distances to centers + top-n_probes
-    # (ref: select_clusters-analog in ivf_flat_search).
-    probe_ids = _coarse_probe(Q, index.centers, n_probes, inner_is_l2)
-
     if index.data.dtype in (jnp.dtype(jnp.uint8), jnp.dtype(jnp.int8)):
         # 8-bit integer storage (the reference's ivf_flat<int8/uint8>
         # instantiations, ivf_flat_search.cuh:456): 8-bit values are
@@ -781,6 +816,29 @@ def search(
     else:
         dataf = _as_float(index.data)
         qsplit = False
+
+    # Packed-cells tier dispatch, BEFORE the bucket-capacity machinery
+    # (the round-4 engine: no measured capacity, no probe drops, one
+    # jitted pipeline — see _cells_search). Gated on the per-list data
+    # block fitting VMEM; bigger lists keep the bucket-table engine.
+    load = Q.shape[0] * n_probes / max(index.n_lists, 1)
+    cap_bytes = dataf.shape[1] * (round_up_safe(index.dim, 128)
+                                  * (2 if dataf.dtype == jnp.bfloat16
+                                     else 4))
+    if (params.engine in ("auto", "bucketed") and k <= 128
+            and cap_bytes <= _CELL_DB_BYTES
+            and (params.engine == "bucketed"
+                 or (jax.default_backend() == "tpu" and load >= 8))):
+        return _cells_search(
+            Q, index.centers, dataf, index.indices, index.list_sizes,
+            n_probes, k, inner_is_l2, sqrt,
+            min(_CELL_QROWS, max(8, Q.shape[0])), qsplit,
+            jax.default_backend() != "tpu")
+
+    # Coarse quantizer: distances to centers + top-n_probes
+    # (ref: select_clusters-analog in ivf_flat_search; the cells path
+    # above probes inside its own jitted pipeline).
+    probe_ids = _coarse_probe(Q, index.centers, n_probes, inner_is_l2)
 
     engine, cap_q = _pick_engine(params.engine, Q.shape[0], n_probes,
                                  index.n_lists, k, params.bucket_cap,

@@ -727,8 +727,11 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     # Quantization", the non-parametric variant). Helps when residual
     # variance is anisotropic across the subspace split; a no-op knob
     # (0) by default.
-    for _ in range(max(0, params.opq_iters)):
-        res = _residuals(trainset, labels, centers, rot, pq_dim)
+    if params.opq_iters > 0:
+        xres = trainset - centers[labels]   # loop-invariant residuals
+    for _ in range(params.opq_iters):
+        res = jnp.matmul(xres, rot.T, precision=lax.Precision.HIGHEST
+                         ).reshape(-1, pq_dim, pq_len)
         data = jnp.swapaxes(res, 0, 1)
         w = jnp.ones(data.shape[:2], data.dtype)
         books_it = _vq_train_batched(state.next_key(), data, w,
@@ -739,7 +742,6 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
         cw = jnp.take_along_axis(
             books_it[None], codes_it[:, :, None, None].astype(jnp.int32),
             axis=2)[:, :, 0, :].reshape(res.shape[0], rot_dim)
-        xres = trainset - centers[labels]
         u, _, vt = jnp.linalg.svd(
             jnp.matmul(cw.T, xres, precision=lax.Precision.HIGHEST),
             full_matrices=False)       # U (rot, min), Vt (min, dim)

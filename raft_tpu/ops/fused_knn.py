@@ -42,6 +42,38 @@ _LANES = 128
 _I32MAX = jnp.iinfo(jnp.int32).max
 
 
+def _distance_tile(q, y, l2: bool, bf16: bool, qsplit: bool):
+    """The shared distance-tile core of all three fused-kNN kernels:
+    MXU gram (optionally bf16, optionally with the split hi/lo query
+    matmul that keeps f32 query precision on the bf16 path) + clamped
+    expanded-L2 epilogue, or negated inner products (min-select order).
+    Precision-sensitive — keep it single-sourced."""
+    dims = (((1,), (1,)), ((), ()))
+    if bf16 and qsplit:
+        yc = y.astype(jnp.bfloat16)
+        qh = q.astype(jnp.bfloat16)
+        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
+        g = (jax.lax.dot_general(qh, yc, dimension_numbers=dims,
+                                 preferred_element_type=jnp.float32)
+             + jax.lax.dot_general(ql, yc, dimension_numbers=dims,
+                                   preferred_element_type=jnp.float32))
+    else:
+        if bf16:
+            qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+        else:
+            qc, yc = q, y
+        g = jax.lax.dot_general(
+            qc, yc, dimension_numbers=dims,
+            preferred_element_type=jnp.float32,
+            precision=(None if bf16 else jax.lax.Precision.HIGHEST))
+    if not l2:
+        return -g
+    yf = y.astype(jnp.float32)  # norms in f32 even for bf16-stored db
+    qn = jnp.sum(q * q, axis=1, keepdims=True)
+    yn = jnp.sum(yf * yf, axis=1)[None, :]
+    return jnp.maximum(qn + yn - 2.0 * g, 0.0)
+
+
 def _kpass_select(work, ids, k: int, kp: int):
     """Extract the k smallest entries of each row of ``work`` (ascending),
     tie-broken by lowest id — the register-queue role of warp_sort_immediate
@@ -104,34 +136,7 @@ def _fused_knn_kernel(q_ref, db_ref, outd_ref, outi_ref, *,
             outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
             outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
 
-    q = q_ref[:]
-    y = db_ref[:]
-    dims = (((1,), (1,)), ((), ()))
-    if bf16 and qsplit:
-        # Split hi/lo query matmul: f32 query precision on the bf16 MXU
-        # path (see _batch_knn_kernel) — only the db operand is rounded.
-        yc = y.astype(jnp.bfloat16)
-        qh = q.astype(jnp.bfloat16)
-        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
-        g = (jax.lax.dot_general(qh, yc, dimension_numbers=dims,
-                                 preferred_element_type=jnp.float32)
-             + jax.lax.dot_general(ql, yc, dimension_numbers=dims,
-                                   preferred_element_type=jnp.float32))
-    else:
-        if bf16:
-            qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
-        else:
-            qc, yc = q, y
-        g = jax.lax.dot_general(
-            qc, yc, dimension_numbers=dims,
-            preferred_element_type=jnp.float32,
-            precision=(None if bf16 else jax.lax.Precision.HIGHEST))
-    if l2:
-        qn = jnp.sum(q * q, axis=1, keepdims=True)
-        yn = jnp.sum(y * y, axis=1)[None, :]
-        work = jnp.maximum(qn + yn - 2.0 * g, 0.0)
-    else:
-        work = -g
+    work = _distance_tile(q_ref[:], db_ref[:], l2, bf16, qsplit)
     ids = j * bd + jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
     work = jnp.where(ids < n, work, jnp.inf)
 
@@ -218,40 +223,7 @@ def _batch_knn_kernel(q_ref, db_ref, bad_ref, outd_ref, outi_ref, *,
             outd_ref[:] = jnp.full(outd_ref.shape, jnp.inf, jnp.float32)
             outi_ref[:] = jnp.full(outi_ref.shape, -1, jnp.int32)
 
-    q = q_ref[0]
-    y = db_ref[0]
-    dims = (((1,), (1,)), ((), ()))
-    if bf16 and qsplit:
-        # Quantized storage (u8/i8 exact in bf16) with *float* queries:
-        # a plain bf16 cast of the query operand would round real-valued
-        # queries and perturb rankings. Split the query into a bf16
-        # high part + bf16 residual — two bf16 MXU passes recover the
-        # f32·bf16 product to ~2^-16 relative error while the db operand
-        # stays on the fast bf16 path (the matmul is a small fraction of
-        # the bucketed step, so the second pass is cheap).
-        yc = y.astype(jnp.bfloat16)
-        qh = q.astype(jnp.bfloat16)
-        ql = (q - qh.astype(jnp.float32)).astype(jnp.bfloat16)
-        g = (jax.lax.dot_general(qh, yc, dimension_numbers=dims,
-                                 preferred_element_type=jnp.float32)
-             + jax.lax.dot_general(ql, yc, dimension_numbers=dims,
-                                   preferred_element_type=jnp.float32))
-    else:
-        if bf16:
-            qc, yc = q.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
-        else:
-            qc, yc = q, y
-        g = jax.lax.dot_general(
-            qc, yc, dimension_numbers=dims,
-            preferred_element_type=jnp.float32,
-            precision=(None if bf16 else jax.lax.Precision.HIGHEST))
-    if l2:
-        yf = y.astype(jnp.float32)  # norms in f32 even for bf16-stored db
-        qn = jnp.sum(q * q, axis=1, keepdims=True)
-        yn = jnp.sum(yf * yf, axis=1)[None, :]
-        work = jnp.maximum(qn + yn - 2.0 * g, 0.0)
-    else:
-        work = -g
+    work = _distance_tile(q_ref[0], db_ref[0], l2, bf16, qsplit)
     ids = j * bd + jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
     work = jnp.where(bad_ref[0], jnp.inf, work)  # (1, bd) broadcasts
 
@@ -366,6 +338,97 @@ def fused_batch_knn(queries, db, invalid, k: int, *, metric: str = "l2",
     bd = min(bd, round_up_safe(n, _LANES))
     return _fused_batch_knn(queries, db, invalid, k, metric == "l2", sqrt,
                             bd, bf16, qsplit, interpret)
+
+
+def _cells_knn_kernel(cell_ref, q_ref, db_ref, bad_ref, outd_ref, outi_ref,
+                      *, k: int, kp: int, l2: bool, bf16: bool,
+                      qsplit: bool):
+    """One grid cell = one packed query cell scoring one list (the
+    round-4 packed-cells layout: the scalar-prefetched ``cell_ref`` maps
+    cell → list for the db/mask block index maps; -1 marks an unused
+    tail cell, skipped entirely). Same distance tile + k-pass selection
+    as ``_batch_knn_kernel``, but cell rows are ≥ half full at skewed
+    probe loads instead of mostly padding."""
+    b = pl.program_id(0)
+    used = cell_ref[b] >= 0
+
+    @pl.when(jnp.logical_not(used))
+    def _():
+        outd_ref[0] = jnp.full(outd_ref.shape[1:], jnp.inf, jnp.float32)
+        outi_ref[0] = jnp.full(outi_ref.shape[1:], -1, jnp.int32)
+
+    @pl.when(used)
+    def _():
+        work = _distance_tile(q_ref[0], db_ref[0], l2, bf16, qsplit)
+        ids = jax.lax.broadcasted_iota(jnp.int32, work.shape, 1)
+        work = jnp.where(bad_ref[0], jnp.inf, work)  # (1, cap) broadcasts
+        nd, ni = _kpass_select(work, ids, k, kp)
+        ni = jnp.where(jnp.isinf(nd), -1, ni)
+        outd_ref[0] = nd
+        outi_ref[0] = ni
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("k", "l2", "bf16", "qsplit", "interpret"))
+def fused_cells_knn(cell_list, queries, db, invalid, k: int, *,
+                    l2: bool = True, bf16: bool = False,
+                    qsplit: bool = False, interpret: bool = False
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Packed-cells batched kNN: cell c scores ``queries[c]`` (qrows, d)
+    against list ``cell_list[c]``'s rows ``db[cell_list[c]]`` (cap, d)
+    with per-slot mask ``invalid``. The IVF-Flat analog of the
+    compressed PQ scan's cell layout (see ivf_flat._invert_probe_map_cells);
+    min-selection order for both metrics (ip scores are negated).
+    Returns (distances (max_cells, qrows, k), local slot ids)."""
+    max_cells, qrows, d = queries.shape
+    n_lists, cap, _ = db.shape
+    kp = round_up_safe(max(k, 1), _LANES)
+    qr = round_up_safe(qrows, 8)
+    capp = round_up_safe(cap, _LANES)
+    dp = round_up_safe(d, _LANES)
+    if qr != qrows or dp != d:
+        queries = jnp.pad(queries, ((0, 0), (0, qr - qrows), (0, dp - d)))
+    if capp != cap or dp != d:
+        db = jnp.pad(db, ((0, 0), (0, capp - cap), (0, dp - d)))
+    if capp != cap:
+        invalid = jnp.pad(invalid, ((0, 0), (0, capp - cap)),
+                          constant_values=True)
+
+    kernel = functools.partial(
+        _cells_knn_kernel, k=k, kp=kp, l2=l2, bf16=bf16, qsplit=qsplit)
+
+    def by_list(b, cl):
+        return (jnp.maximum(cl[b], 0), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(max_cells,),
+        in_specs=[
+            pl.BlockSpec((1, qr, dp), lambda b, cl: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, capp, dp), by_list,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, capp), by_list,
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, qr, kp), lambda b, cl: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, qr, kp), lambda b, cl: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+    )
+    outd, outi = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((max_cells, qr, kp), jnp.float32),
+            jax.ShapeDtypeStruct((max_cells, qr, kp), jnp.int32),
+        ],
+        interpret=interpret,
+    )(cell_list, queries, db, invalid[:, None, :])
+    return outd[:, :qrows, :k], outi[:, :qrows, :k]
 
 
 def fused_knn_supported(m: int, n: int, d: int, k: int) -> bool:

@@ -154,13 +154,16 @@ def test_bucketed_measured_cap_skewed_queries(rng):
     assert agree > 0.999, f"measured-cap bucketed != scan on skew: {agree}"
 
 
-def test_search_traceable_under_jit(rng):
-    """search(engine='auto') must stay jittable: under a trace no
-    data-dependent capacity can be measured, so auto degrades to the exact
-    scan engine; explicit bucketed with cap=0 raises a clear error."""
+def test_search_traceable_under_jit(rng, monkeypatch):
+    """search must stay jittable. engine='auto'/'bucketed' now trace
+    through the packed-cells tier (round 4 — fully traceable, no
+    capacity measurement); with the cells tier unavailable, a traced
+    bucketed request with cap=0 still raises the clear bucket_cap error
+    (no data-dependent capacity can be measured under a trace)."""
     import jax
 
     from raft_tpu.core.error import RaftError
+    from raft_tpu.neighbors import ivf_flat as impl
 
     db = rng.normal(size=(2000, 16)).astype(np.float32)
     Q = rng.normal(size=(50, 16)).astype(np.float32)
@@ -171,6 +174,13 @@ def test_search_traceable_under_jit(rng):
     d_e, i_e = ivf_flat.search(
         ivf_flat.SearchParams(n_probes=8, engine="scan"), idx, Q, 5)
     np.testing.assert_array_equal(np.asarray(i_jit), np.asarray(i_e))
+    # bucketed under jit now resolves to the traceable cells tier
+    d_b, i_b = jax.jit(lambda q: ivf_flat.search(
+        ivf_flat.SearchParams(n_probes=8, engine="bucketed"),
+        idx, q, 5))(Q)
+    np.testing.assert_array_equal(np.asarray(i_b), np.asarray(i_e))
+    # legacy bucket-table engine (cells gated off): traced cap=0 raises
+    monkeypatch.setattr(impl, "_CELL_DB_BYTES", 0)
     with pytest.raises(RaftError, match="bucket_cap"):
         jax.jit(lambda q: ivf_flat.search(
             ivf_flat.SearchParams(n_probes=8, engine="bucketed"),
@@ -206,6 +216,10 @@ def test_measured_cap_cached_per_index(rng, monkeypatch):
     Q = rng.normal(size=(200, 16)).astype(np.float32)
     idx = impl.build(impl.IndexParams(n_lists=16, kmeans_n_iters=4), db)
 
+    # The measured-capacity machinery belongs to the legacy bucket-table
+    # engine; gate the round-4 cells tier off to exercise it.
+    monkeypatch.setattr(impl, "_CELL_DB_BYTES", 0)
+
     calls = []
     real = impl._front_rank_contention
 
@@ -229,14 +243,18 @@ def test_measured_cap_cached_per_index(rng, monkeypatch):
     assert len(calls) == 3
 
 
-def test_skew_bound_never_drops_best_probe(rng):
+def test_skew_bound_never_drops_best_probe(rng, monkeypatch):
     """Extreme skew: every query's rank-0 probe is the same list, with
     n_lists > 8*n_probes so the 8x-mean-load bound (128) sits BELOW the
     rank-0 contention (256) — the floor must win, so each query's
     nearest-list candidates survive and its true NN is found. Explicit
     engine='bucketed' with bucket_cap=0 forces the measured sizing on
-    every backend (auto would pick scan off-TPU)."""
+    every backend (auto would pick scan off-TPU). The round-4 cells
+    tier is gated off — it has no capacity to measure (drop-free by
+    construction; covered by the parity tests above)."""
     from raft_tpu.neighbors import ivf_flat as impl
+
+    monkeypatch.setattr(impl, "_CELL_DB_BYTES", 0)
 
     # One tight hot cluster + scattered others across 64 lists.
     hot = rng.normal(size=(400, 8)).astype(np.float32) * 0.05
