@@ -291,8 +291,8 @@ def _family_1m():
 
     # Index tensors ride as scan arguments (a closure would bake ~0.5 GB
     # of constants into the compiled program; see _family).
-    sp = ivf_flat.SearchParams(n_probes=32, engine="bucketed",
-                               bucket_cap=256)
+    # bucket_cap=0 resolves to the round-4 packed-cells tier.
+    sp = ivf_flat.SearchParams(n_probes=32, engine="bucketed")
 
     def flat_search(q, centers, data, indices, sizes):
         idx = ivf_flat.Index(metric=fidx.metric, centers=centers,
@@ -439,8 +439,7 @@ def _family_sift1m_u8():
 
     fidx = ivf_flat.build(ivf_flat.IndexParams(n_lists=1024), X)
     assert fidx.data.dtype == np.uint8          # quantized at rest
-    spf = ivf_flat.SearchParams(n_probes=32, engine="bucketed",
-                                bucket_cap=256)
+    spf = ivf_flat.SearchParams(n_probes=32, engine="bucketed")
     _, i = ivf_flat.search(spf, fidx, Q, 10)
     rec = _recall(np.asarray(i), truth)
     qps, spread = _eager_qps(

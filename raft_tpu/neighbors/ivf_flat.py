@@ -78,11 +78,14 @@ class SearchParams:
     into per-list MXU work (the query-grouping of calc_chunk_indices,
     detail/ivf_pq_search.cuh:267, turned into dense tiles). Since round
     4 it resolves to the PACKED-CELLS tier whenever k ≤ 128 and one
-    list's data block fits the VMEM budget: fixed-width query cells (hot
-    lists own several), no (query, probe) pair ever dropped, no
-    capacity measurement, fully traceable under jit — ``bucket_cap`` is
-    ignored on that tier. "auto" picks it on TPU when the probe load
-    q·n_probes/n_lists is high enough to fill tiles.
+    list's data block fits the VMEM budget AND ``bucket_cap`` is 0:
+    fixed-width query cells (hot lists own several), no (query, probe)
+    pair ever dropped, no capacity measurement, fully traceable under
+    jit. An explicit ``bucket_cap`` keeps the legacy bucket-table
+    engine below (its documented capacity/drop semantics; a well-packed
+    hand-tuned table can win at uniform probe loads). "auto" picks
+    cells on TPU when the probe load q·n_probes/n_lists is high enough
+    to fill tiles.
 
     Only when the cells tier is unavailable (k > 128 or oversized list
     blocks) does "bucketed" fall back to the legacy bucket-table engine,
@@ -656,6 +659,23 @@ def _invert_probe_map(probe_ids, n_lists: int, bucket_cap: int):
     what :func:`_route_candidates` needs to send per-pair results back to
     their queries."""
     q, p = probe_ids.shape
+    sorted_lists, sorted_query, pos, order = _sorted_probe_pairs(
+        probe_ids, n_lists)
+    keep = pos < bucket_cap
+    slot = jnp.where(keep, sorted_lists * bucket_cap + pos,
+                     n_lists * bucket_cap)                     # OOB → drop
+    bucket = (jnp.full((n_lists * bucket_cap,), -1, jnp.int32)
+              .at[slot].set(sorted_query, mode="drop")
+              .reshape(n_lists, bucket_cap))
+    return bucket, (sorted_lists, pos, keep, order)
+
+
+def _sorted_probe_pairs(probe_ids, n_lists: int):
+    """Shared prefix of both probe-map inverters: flatten (query, probe)
+    pairs probe-rank-major, stable-sort by list id, and compute each
+    pair's rank within its list. Returns ``(sorted_lists, sorted_query,
+    pos, order)``."""
+    q, p = probe_ids.shape
     flat_lists = probe_ids.T.reshape(-1)                       # (p·q,)
     flat_query = jnp.tile(jnp.arange(q, dtype=jnp.int32), p)
     order = jnp.argsort(flat_lists, stable=True)
@@ -664,13 +684,7 @@ def _invert_probe_map(probe_ids, n_lists: int, bucket_cap: int):
     starts = jnp.searchsorted(sorted_lists,
                               jnp.arange(n_lists, dtype=jnp.int32))
     pos = jnp.arange(q * p, dtype=jnp.int32) - starts[sorted_lists]
-    keep = pos < bucket_cap
-    slot = jnp.where(keep, sorted_lists * bucket_cap + pos,
-                     n_lists * bucket_cap)                     # OOB → drop
-    bucket = (jnp.full((n_lists * bucket_cap,), -1, jnp.int32)
-              .at[slot].set(sorted_query, mode="drop")
-              .reshape(n_lists, bucket_cap))
-    return bucket, (sorted_lists, pos, keep, order)
+    return sorted_lists, sorted_query, pos, order
 
 
 def _invert_probe_map_cells(probe_ids, n_lists: int, qrows: int):
@@ -687,14 +701,8 @@ def _invert_probe_map_cells(probe_ids, n_lists: int, qrows: int):
     q·p // qrows + n_lists (one partial cell per list at worst)."""
     q, p = probe_ids.shape
     max_cells = (q * p) // qrows + n_lists
-    flat_lists = probe_ids.T.reshape(-1)                       # (p·q,)
-    flat_query = jnp.tile(jnp.arange(q, dtype=jnp.int32), p)
-    order = jnp.argsort(flat_lists, stable=True)
-    sorted_lists = flat_lists[order].astype(jnp.int32)
-    sorted_query = flat_query[order]
-    starts = jnp.searchsorted(sorted_lists,
-                              jnp.arange(n_lists, dtype=jnp.int32))
-    pos = jnp.arange(q * p, dtype=jnp.int32) - starts[sorted_lists]
+    sorted_lists, sorted_query, pos, order = _sorted_probe_pairs(
+        probe_ids, n_lists)
     loads = jnp.bincount(sorted_lists, length=n_lists)
     n_cells = (loads + qrows - 1) // qrows
     base_cell = jnp.cumsum(n_cells) - n_cells                  # exclusive
@@ -822,10 +830,18 @@ def search(
     # jitted pipeline — see _cells_search). Gated on the per-list data
     # block fitting VMEM; bigger lists keep the bucket-table engine.
     load = Q.shape[0] * n_probes / max(index.n_lists, 1)
-    cap_bytes = dataf.shape[1] * (round_up_safe(index.dim, 128)
-                                  * (2 if dataf.dtype == jnp.bfloat16
-                                     else 4))
+    # f32 accounting regardless of storage dtype: the kernel's L2
+    # epilogue upcasts the db block to f32 for the norms, so a bf16
+    # (quantized-storage) block's true VMEM footprint is the f32 one.
+    cap_bytes = (round_up_safe(dataf.shape[1], 128)
+                 * round_up_safe(index.dim, 128) * 4)
+    # An explicit bucket_cap keeps the legacy bucket-table engine (its
+    # documented capacity/drop semantics); cells applies at cap=0 —
+    # at uniform probe loads a well-packed hand-tuned bucket table can
+    # still win (123K vs 87K QPS at the 100K bench shape), while cells
+    # wins at skewed/heavy loads and under jit.
     if (params.engine in ("auto", "bucketed") and k <= 128
+            and params.bucket_cap == 0
             and cap_bytes <= _CELL_DB_BYTES
             and (params.engine == "bucketed"
                  or (jax.default_backend() == "tpu" and load >= 8))):
