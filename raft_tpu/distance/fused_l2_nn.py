@@ -52,6 +52,12 @@ def fused_l2_nn_min_reduce(
     accumulation), "split" = y rounded to bf16, x recovered by a hi/lo
     double matmul (~2^-16 relative x error — near-tied argmins may flip
     on the y rounding only), "full" = both operands bf16.
+
+    ``tile_n`` applies to the tiled XLA fallback path only (it bounds
+    that path's per-step (m, tile_n) workspace); the TPU Pallas kernel
+    sizes its own VMEM-budgeted tiles, so a non-default ``tile_n``
+    keeps the fallback engine rather than silently dispatching a
+    kernel with different tiling.
     """
     expects(bf16 in (None, "split", "full"),
             f"bf16 must be None, 'split' or 'full' (got {bf16!r})")
@@ -68,6 +74,7 @@ def fused_l2_nn_min_reduce(
 
     if (jax.default_backend() == "tpu" and x.dtype == jnp.float32
             and y.dtype == jnp.float32 and k <= 1024 and n >= 2
+            and tile_n == _TILE_N
             and precision in (DEFAULT_PRECISION, lax.Precision.HIGHEST)):
         # Pallas fused kernel (k=1 top-k queue): the (m, n) tile never
         # leaves VMEM. Ref: detail/fused_l2_nn.cuh:129.

@@ -748,10 +748,35 @@ def _route_candidates(bd_, gi, route, q: int, p: int, bucket_cap: int,
     return cd, ci
 
 
-# Query-slot width of one packed cell (see _invert_probe_map_cells) and
-# the VMEM budget for one list's data block in the cells kernel.
+# Query-slot width of one packed cell (see _invert_probe_map_cells), the
+# VMEM budget for one list's data block in the cells kernel, and the
+# widest top-k queue the cells kernels carry (two 128-lane groups — the
+# reference warpsort's kMaxCapacity=256, select_warpsort.cuh:100).
 _CELL_QROWS = 64
 _CELL_DB_BYTES = 6 * 1024 * 1024
+_CELLS_MAX_K = 128
+
+
+def _cells_eligible(engine: str, k: int, bucket_cap: int, cap: int,
+                    dim: int, n_queries: int, n_probes: int,
+                    n_lists: int) -> bool:
+    """Single definition of the packed-cells tier dispatch gate, shared
+    by :func:`search` and the sharded search (parallel/ivf.py) so the
+    two paths cannot drift: engine allows it, k within the cells queue,
+    no explicit bucket_cap (which keeps the legacy bucket-table engine),
+    the per-list data block within the VMEM budget (f32 accounting — the
+    kernel's L2 epilogue upcasts bf16 storage), and for "auto" a TPU
+    backend with enough probe load to fill the tiles."""
+    if not (engine in ("auto", "bucketed") and k <= _CELLS_MAX_K
+            and bucket_cap == 0):
+        return False
+    cap_bytes = round_up_safe(cap, 128) * round_up_safe(dim, 128) * 4
+    if cap_bytes > _CELL_DB_BYTES:
+        return False
+    if engine == "bucketed":
+        return True
+    load = n_queries * n_probes / max(n_lists, 1)
+    return jax.default_backend() == "tpu" and load >= 8
 
 
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
@@ -827,24 +852,14 @@ def search(
 
     # Packed-cells tier dispatch, BEFORE the bucket-capacity machinery
     # (the round-4 engine: no measured capacity, no probe drops, one
-    # jitted pipeline — see _cells_search). Gated on the per-list data
-    # block fitting VMEM; bigger lists keep the bucket-table engine.
-    load = Q.shape[0] * n_probes / max(index.n_lists, 1)
-    # f32 accounting regardless of storage dtype: the kernel's L2
-    # epilogue upcasts the db block to f32 for the norms, so a bf16
-    # (quantized-storage) block's true VMEM footprint is the f32 one.
-    cap_bytes = (round_up_safe(dataf.shape[1], 128)
-                 * round_up_safe(index.dim, 128) * 4)
-    # An explicit bucket_cap keeps the legacy bucket-table engine (its
-    # documented capacity/drop semantics); cells applies at cap=0 —
-    # at uniform probe loads a well-packed hand-tuned bucket table can
-    # still win (123K vs 87K QPS at the 100K bench shape), while cells
-    # wins at skewed/heavy loads and under jit.
-    if (params.engine in ("auto", "bucketed") and k <= 128
-            and params.bucket_cap == 0
-            and cap_bytes <= _CELL_DB_BYTES
-            and (params.engine == "bucketed"
-                 or (jax.default_backend() == "tpu" and load >= 8))):
+    # jitted pipeline — see _cells_search). An explicit bucket_cap keeps
+    # the legacy bucket-table engine (its documented capacity/drop
+    # semantics); at uniform probe loads a well-packed hand-tuned bucket
+    # table can still win (123K vs 87K QPS at the 100K bench shape),
+    # while cells wins at skewed/heavy loads and under jit.
+    if _cells_eligible(params.engine, k, params.bucket_cap,
+                       dataf.shape[1], index.dim, Q.shape[0], n_probes,
+                       index.n_lists):
         return _cells_search(
             Q, index.centers, dataf, index.indices, index.list_sizes,
             n_probes, k, inner_is_l2, sqrt,

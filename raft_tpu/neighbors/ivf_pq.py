@@ -50,6 +50,8 @@ from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.matrix.select_k import select_k
 from raft_tpu.neighbors.ivf_flat import (
+    _CELL_QROWS,       # single definition of the cells packing width —
+    _CELLS_MAX_K,      # a drifted local copy would mismatch the kernels
     _append_in_place,
     _auto_cap_cache,
     _bucketed_probe_scan,
@@ -454,17 +456,51 @@ def _compressed_eligible(params: "SearchParams", index: Index,
     """Single definition of the compressed-tier dispatch gate, shared by
     :func:`search` and :func:`search_refined` (two re-spelled copies
     would drift): supported config, no user recon cache, default score
-    dtypes, queue width within the kernel's cap, and — for
-    engine="auto" — a TPU backend with enough probe load to beat the
-    scan engine."""
-    if not (params.engine in ("auto", "bucketed")
-            and _compressed_supported(index) and index._recon is None
-            and default_dtypes and k_pool <= 128):
+    dtypes, queue width within the kernel's cap, per-list Pallas blocks
+    within the VMEM budget, and — for engine="auto" — a TPU backend with
+    enough probe load to beat the scan engine."""
+    return (index._recon is None and _compressed_tier_ok(
+        params.engine, _compressed_supported(index), default_dtypes,
+        k_pool, index.pq_codes.shape[1], index.pq_codes.shape[2],
+        index.rot_dim, n_queries, n_probes, index.n_lists))
+
+
+def _compressed_tier_ok(engine: str, supported: bool, default_dtypes: bool,
+                        k_pool: int, cap: int, nbytes: int, rot_dim: int,
+                        n_queries: int, n_probes: int,
+                        n_lists: int) -> bool:
+    """Scalar core of the compressed-tier gate, also used by the sharded
+    search (parallel/ivf.py, with the per-SHARD cap/nbytes) so the
+    single-chip and multi-chip dispatch cannot drift."""
+    if not (engine in ("auto", "bucketed") and supported
+            and default_dtypes and k_pool <= _CELLS_MAX_K):
         return False
-    if params.engine == "bucketed":
+    if not _compressed_vmem_ok(cap, nbytes, rot_dim):
+        return False
+    if engine == "bucketed":
         return True
-    load = n_queries * n_probes / max(index.n_lists, 1)
+    load = n_queries * n_probes / max(n_lists, 1)
     return jax.default_backend() == "tpu" and load >= 8
+
+
+def _compressed_vmem_ok(cap: int, nbytes: int, rot_dim: int) -> bool:
+    """VMEM gate for the compressed-tier per-list Pallas blocks (the
+    IVF-Flat cells tier gates the same way on _CELL_DB_BYTES): the
+    dominant per-grid-cell operands are the transposed code block
+    (nbytes, capp) u8, the slot mask (1, capp) and the two absolute
+    tables (rot_dim, 128) f32 each. An index with few, very large lists
+    (small n_lists at multi-million scale) would otherwise fail at
+    Mosaic compile time instead of falling through to the recon/LUT
+    tiers."""
+    from raft_tpu.ops.pq_scan import _SC
+    capp = ceildiv(max(cap, 1), _SC) * _SC
+    block_bytes = nbytes * capp + capp + 2 * rot_dim * 128 * 4
+    return block_bytes <= _PQ_CELL_BYTES
+
+
+# Per-list VMEM budget for the compressed-scan blocks (double-buffered by
+# the pipeline, so this is ~half the usable VMEM after queries/outputs).
+_PQ_CELL_BYTES = 6 * 1024 * 1024
 
 
 def _compressed_supported(index: Index) -> bool:
@@ -476,12 +512,6 @@ def _compressed_supported(index: Index) -> bool:
     return (index.codebook_kind == CodebookGen.PER_SUBSPACE
             and (index.pq_bits == 8
                  or (index.pq_bits == 4 and index.pq_dim % 2 == 0)))
-
-
-# Query-slot width of one packed compressed-scan cell (rows per grid
-# cell; the matmul M-dim and select row count — see
-# _invert_probe_map_cells). Multiple of 8 (f32 sublane tile).
-_CELL_QROWS = 64
 
 
 @functools.partial(jax.jit,
@@ -528,8 +558,6 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
     if is_ip:
         best_d = -best_d
     return best_d, best_i
-
-
 
 
 def _as_float(x) -> jax.Array:
