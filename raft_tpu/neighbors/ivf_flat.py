@@ -77,17 +77,18 @@ class SearchParams:
     gather path (exact probe coverage). "bucketed" inverts the probe map
     into per-list MXU work (the query-grouping of calc_chunk_indices,
     detail/ivf_pq_search.cuh:267, turned into dense tiles). Since round
-    4 it resolves to the PACKED-CELLS tier whenever k ≤ 128 and one
-    list's data block fits the VMEM budget AND ``bucket_cap`` is 0:
-    fixed-width query cells (hot lists own several), no (query, probe)
-    pair ever dropped, no capacity measurement, fully traceable under
-    jit. An explicit ``bucket_cap`` keeps the legacy bucket-table
-    engine below (its documented capacity/drop semantics; a well-packed
-    hand-tuned table can win at uniform probe loads). "auto" picks
-    cells on TPU when the probe load q·n_probes/n_lists is high enough
-    to fill tiles.
+    4 it resolves to the PACKED-CELLS tier whenever k ≤ 256 (the
+    two-lane-group k-pass queue — the reference warpsort's
+    kMaxCapacity, select_warpsort.cuh:100) and one list's data block
+    fits the VMEM budget AND ``bucket_cap`` is 0: fixed-width query
+    cells (hot lists own several), no (query, probe) pair ever dropped,
+    no capacity measurement, fully traceable under jit. An explicit
+    ``bucket_cap`` keeps the legacy bucket-table engine below (its
+    documented capacity/drop semantics; a well-packed hand-tuned table
+    can win at uniform probe loads). "auto" picks cells on TPU when the
+    probe load q·n_probes/n_lists is high enough to fill tiles.
 
-    Only when the cells tier is unavailable (k > 128 or oversized list
+    Only when the cells tier is unavailable (k > 256 or oversized list
     blocks) does "bucketed" fall back to the legacy bucket-table engine,
     where ``bucket_cap`` applies: a list probed by more than
     ``bucket_cap`` queries drops the excess pairs best-centroid-rank-
@@ -754,7 +755,7 @@ def _route_candidates(bd_, gi, route, q: int, p: int, bucket_cap: int,
 # reference warpsort's kMaxCapacity=256, select_warpsort.cuh:100).
 _CELL_QROWS = 64
 _CELL_DB_BYTES = 6 * 1024 * 1024
-_CELLS_MAX_K = 128
+_CELLS_MAX_K = 256
 
 
 def _cells_eligible(engine: str, k: int, bucket_cap: int, cap: int,

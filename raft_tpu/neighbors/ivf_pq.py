@@ -140,6 +140,13 @@ class IndexParams:
     opq_iters: int = 0
     add_data_on_build: bool = True
     conservative_memory_allocation: bool = False
+    # TPU extension: build() keeps a REFERENCE to the dataset on the
+    # index (no copy — the caller's array is kept alive) so
+    # SearchParams.min_recall can refine internally. False releases it
+    # with the caller's last reference — the index then holds packed
+    # codes only (the PQ compression story), and recall-class requests
+    # need an explicit search_refined(dataset=...).
+    retain_dataset: bool = True
     # Neighbor-id dtype: int32 (default) or int64 (reference IdxT parity;
     # requires jax_enable_x64). See ivf_flat.IndexParams.idx_dtype.
     idx_dtype: object = jnp.int32
@@ -165,6 +172,14 @@ class SearchParams:
     # (Index.reconstructed) instead of LUT gathers; "scan" is the LUT path.
     engine: str = "auto"
     bucket_cap: int = 0
+    # TPU extension: requested recall class. Plain 8-bit PQ saturates
+    # near ~0.83 recall@10 on structureless query regimes (BASELINE.md);
+    # a request above _REFINE_RECALL_CLASS makes search() run the
+    # reference's over-retrieve + exact-refine recipe internally
+    # (neighbors/refine.cuh pairing) against the dataset retained on the
+    # index (Index._source; build() keeps a reference when ids are the
+    # default row numbering). None = never refine (reference behavior).
+    min_recall: Optional[float] = None
 
 
 def validate_search_dtypes(params: "SearchParams"):
@@ -211,6 +226,13 @@ class Index:
     # Lazy compressed-scan operands (transposed codes + per-list absolute
     # codeword tables); see compressed_scan_operands(). Not serialized.
     _scan_ops: Optional[tuple] = None
+    # Reference to the dataset the index was built over, kept only while
+    # the stored ids are the default global row numbering (build/extend
+    # with default indices). Enables SearchParams.min_recall's internal
+    # exact-refine without a separate API; a reference, not a copy — the
+    # caller's array is simply kept alive. Not serialized (load() leaves
+    # it None; attach via refine-capable search_refined instead).
+    _source: Optional[jax.Array] = None
 
     def __post_init__(self):
         # pq_dim is load-bearing (codes are bit-packed, so it is no longer
@@ -649,6 +671,14 @@ _ENCODE_CHUNK = 4096
 # PQ's compression — the user must opt in with engine="bucketed".
 _RECON_AUTO_BYTES = 4 * 1024 ** 3
 
+# Native (unrefined) 8-bit PQ saturates near 0.83 recall@10 on
+# structureless regimes (BASELINE.md); a SearchParams.min_recall above
+# this makes search() run the exact-refine recipe internally.
+_REFINE_RECALL_CLASS = 0.84
+
+# Row cap for the OPQ alternation's sub-trainset (see build step 3b).
+_OPQ_TRAIN_ROWS = 100_000
+
 
 def _chunked_rows(fn, *arrays):
     """Apply ``fn(rows...) -> (chunk, pq_dim)`` over row chunks of equal
@@ -756,7 +786,16 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     # variance is anisotropic across the subspace split; a no-op knob
     # (0) by default.
     if params.opq_iters > 0:
-        xres = trainset - centers[labels]   # loop-invariant residuals
+        # Rotation estimation converges on far fewer rows than codebook
+        # training needs — cap the OPQ sub-trainset so the alternation's
+        # extra live tensors (residuals + quantized reconstruction) stay
+        # ~50 MB instead of scaling with the full trainset (a 1M build
+        # with the full 500K trainset OOM'd a 16 GB chip).
+        stride_o = max(1, trainset.shape[0] // _OPQ_TRAIN_ROWS)
+        sub = trainset[::stride_o][:_OPQ_TRAIN_ROWS]
+        # The sub-trainset is an exact subsample of trainset, whose
+        # labels are already computed above — no second assignment pass.
+        xres = sub - centers[labels[::stride_o][:_OPQ_TRAIN_ROWS]]
     for _ in range(params.opq_iters):
         res = jnp.matmul(xres, rot.T, precision=lax.Precision.HIGHEST
                          ).reshape(-1, pq_dim, pq_len)
@@ -774,6 +813,8 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
             jnp.matmul(cw.T, xres, precision=lax.Precision.HIGHEST),
             full_matrices=False)       # U (rot, min), Vt (min, dim)
         rot = jnp.matmul(u, vt, precision=lax.Precision.HIGHEST)
+    if params.opq_iters > 0:
+        xres = sub = None              # release before codebook training
 
     res = _residuals(trainset, labels, centers, rot, pq_dim)  # (nt, pq_dim, l)
 
@@ -816,6 +857,11 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     if params.add_data_on_build:
         index = extend(index, X,
                        jnp.arange(n, dtype=index.indices.dtype))
+        if params.retain_dataset:
+            # Stored ids are the row numbering of ``dataset`` — keep a
+            # reference (not a copy) so SearchParams.min_recall can
+            # refine internally. extend() maintains or drops it.
+            index._source = X
     return index
 
 
@@ -865,12 +911,24 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     n_new = X.shape[0]
     if n_new == 0:
         return index
-    if new_indices is None:
+    default_ids = new_indices is None
+    if default_ids:
         base = index.size
         new_indices = jnp.arange(base, base + n_new,
                                  dtype=index.indices.dtype)
     else:
         new_indices = as_array(new_indices).astype(index.indices.dtype)
+
+    # Maintain the retained-dataset reference (min_recall refine): only
+    # a default-numbered append onto a same-dtype source keeps the
+    # id -> source-row mapping valid; anything else drops it.
+    if index._source is not None:
+        raw = as_array(new_vectors)
+        if (default_ids and index._source.shape[0] == index.size
+                and raw.dtype == index._source.dtype):
+            index._source = jnp.concatenate([index._source, raw])
+        else:
+            index._source = None
 
     labels, codes = encode_rows(index, X)
 
@@ -1055,6 +1113,31 @@ def search(
     Q = _as_float(queries)
     expects(Q.ndim == 2 and Q.shape[1] == index.dim, "query dim mismatch")
     lut_dtype, internal_dtype = validate_search_dtypes(params)
+
+    # Recall-class request above the native PQ ceiling: run the exact-
+    # refine recipe internally (the reference pairs ivf_pq with
+    # neighbors/refine.cuh the same way; here the engine dispatch does
+    # it so the caller never spells "refined"). The (n_probes, ratio)
+    # mapping is measured on the 1M regimes: native saturates ~0.83
+    # uniform; n_probes>=48 + ratio 2 reaches 0.92-class, ratio 4 +
+    # n_probes>=64 the 0.95-class (BASELINE.md).
+    if (params.min_recall is not None
+            and params.min_recall > _REFINE_RECALL_CLASS):
+        if index._source is not None:
+            import dataclasses
+            ratio = 4 if params.min_recall >= 0.95 else 2
+            sp = dataclasses.replace(
+                params, min_recall=None,
+                n_probes=max(params.n_probes, 64 if ratio == 4 else 48))
+            return search_refined(sp, index, index._source, queries, k,
+                                  refine_ratio=ratio, handle=handle)
+        from raft_tpu.core.logger import logger
+        logger.warning(
+            "min_recall=%.2f requested but the index retains no source "
+            "dataset (loaded index, or extend with custom ids) - running "
+            "the native PQ search; use search_refined(dataset=...) for "
+            "the exact-refine recipe", params.min_recall)
+
     n_probes = min(params.n_probes, index.n_lists)
     # Static capacity clamp keeps search traceable (jit/scan over query
     # batches); empty slots are masked inside _pq_probe_scan.
@@ -1158,13 +1241,27 @@ def search_refined(
     clears the 0.86-class uniform-regime bar: plain 8-bit PQ saturates
     near 0.83 there, see BASELINE.md). ``dataset`` is the original
     row-major dataset the index was built over (the PQ index stores only
-    codes). Both stages run as jitted programs; the refine adds one
-    candidate gather + a (q, ratio·k, dim) exact distance batch.
-    Returns ``(distances, neighbors)`` like :func:`search`.
+    codes); ``None`` uses the reference retained by build()
+    (``Index._source``). Both stages run as jitted programs; the refine
+    adds one candidate gather + a (q, ratio·k, dim) exact distance
+    batch. Returns ``(distances, neighbors)`` like :func:`search`.
+    Callers can request this recipe implicitly via
+    ``SearchParams.min_recall`` instead.
     """
     from raft_tpu.neighbors.refine import refine
 
+    if dataset is None:
+        dataset = index._source
+        expects(dataset is not None,
+                "search_refined(dataset=None) needs the build-retained "
+                "dataset; this index has none (loaded, or extended with "
+                "custom ids) - pass the dataset explicitly")
     expects(refine_ratio >= 1, "refine_ratio must be >= 1")
+    if params.min_recall is not None:
+        # The refine recipe is already running — a still-set min_recall
+        # would re-trigger it inside the internal candidate search.
+        import dataclasses
+        params = dataclasses.replace(params, min_recall=None)
     refine_ratio = int(refine_ratio)
     if refine_ratio == 1:
         return search(params, index, queries, k, handle=handle)
