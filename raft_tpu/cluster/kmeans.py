@@ -47,6 +47,7 @@ def min_cluster_and_distance(
     X: jax.Array,
     centroids: jax.Array,
     metric: DistanceType = DistanceType.L2Expanded,
+    bf16=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-sample (nearest-centroid index, distance).
 
@@ -54,10 +55,13 @@ def min_cluster_and_distance(
     fusedL2NN when the metric is L2, else pairwise + argmin.
     Returns ``(labels int32 (n,), dists (n,))`` where dists follow the
     metric's convention (squared L2 for L2Expanded, like the reference).
+    ``bf16`` selects the fused kernel's MXU precision tier on the L2
+    path (see fused_l2_nn_min_reduce); non-L2 metrics ignore it.
     """
     if metric in (DistanceType.L2Expanded, DistanceType.L2SqrtExpanded):
         d, i = fused_l2_nn_min_reduce(
-            X, centroids, sqrt=(metric == DistanceType.L2SqrtExpanded)
+            X, centroids, sqrt=(metric == DistanceType.L2SqrtExpanded),
+            bf16=bf16,
         )
         return i, d
     dmat = pairwise_distance_fn(X, centroids, metric=metric)
@@ -180,13 +184,19 @@ def sample_centroids(key, X, n_to_sample: int) -> jax.Array:
 # Lloyd EM
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
 def _lloyd(X, centroids0, sample_weight, max_iter: int, tol: float,
-           metric: DistanceType = DistanceType.L2Expanded):
+           metric: DistanceType = DistanceType.L2Expanded,
+           fast: bool = False):
     """EM loop (ref: kmeans_fit_main, cluster/detail/kmeans.cuh:359-545):
     assign via fused L2 NN (or pairwise+argmin for non-L2 metrics, the same
     dispatch as minClusterAndDistanceCompute) → weighted mean update →
-    centroid-shift convergence test. Static shapes; runs entirely under jit."""
+    centroid-shift convergence test. Static shapes; runs entirely under jit.
+
+    ``fast`` runs the in-loop assignments with the split-bf16 fused
+    kernel (the shift-based convergence test is unchanged); the
+    post-loop assignment that produces the RETURNED labels/inertia is
+    always exact f32."""
     n_clusters = centroids0.shape[0]
     sqnorm_tol = jnp.asarray(tol, X.dtype)
 
@@ -196,7 +206,8 @@ def _lloyd(X, centroids0, sample_weight, max_iter: int, tol: float,
 
     def body(state):
         it, centroids, _, _ = state
-        labels, dists = min_cluster_and_distance(X, centroids, metric)
+        labels, dists = min_cluster_and_distance(
+            X, centroids, metric, bf16="split" if fast else None)
         new, _ = update_centroids(
             X, labels, n_clusters, centroids_old=centroids, sample_weight=sample_weight
         )
@@ -242,7 +253,8 @@ def fit(
         else:
             c0 = init_plus_plus(key, X, params.n_clusters)
         centroids, labels, inertia, it = _lloyd(
-            X, c0, w, params.max_iter, params.tol, params.metric
+            X, c0, w, params.max_iter, params.tol, params.metric,
+            fast=jax.default_backend() == "tpu",
         )
         if best is None or float(inertia) < float(best[1]):
             best = (centroids, inertia, it)

@@ -18,6 +18,8 @@ from raft_tpu.comms.comms_test import (
     test_collective_allgather,
     test_collective_reducescatter,
     test_pointToPoint_simple_send_recv,
+    test_pointToPoint_device_multicast_sendrecv,
+    test_pointToPoint_host_sendrecv,
     test_commsplit,
 )
 
@@ -28,5 +30,6 @@ __all__ = [
     "test_collective_gatherv", "test_collective_broadcast",
     "test_collective_reduce", "test_collective_allgather",
     "test_collective_reducescatter", "test_pointToPoint_simple_send_recv",
-    "test_commsplit",
+    "test_pointToPoint_device_multicast_sendrecv",
+    "test_pointToPoint_host_sendrecv", "test_commsplit",
 ]

@@ -30,6 +30,7 @@ from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -216,10 +217,55 @@ class Comms:
 
     def shift(self, x, offset: int = 1):
         """Ring shift by ``offset`` (the ppermute idiom behind
-        device_multicast_sendrecv-style neighbor exchanges)."""
+        neighbor exchanges)."""
         size = self.get_size() if self.mesh is not None else lax.axis_size(self.axis)
         perm = [(i, (i + offset) % size) for i in range(size)]
         return lax.ppermute(x, self.axis, perm)
+
+    def device_multicast_sendrecv(self, x, axis: int = 0):
+        """Per-rank multi-destination exchange (ref:
+        comms_t::device_multicast_sendrecv, core/comms.hpp:218): slab j
+        of ``x`` along ``axis`` is this rank's payload for rank j; the
+        result has slab j = what rank j sent to this rank. The reference
+        issues a vector of paired NCCL send/recvs inside a group; on the
+        mesh the whole pattern is ONE XLA all_to_all riding ICI/DCN.
+        Ragged per-destination sizes (the sendsizes/sendoffsets vectors)
+        pad to the max slab — XLA's static shapes, same convention as
+        gatherv."""
+        return lax.all_to_all(x, self.axis, split_axis=axis,
+                              concat_axis=axis, tiled=True)
+
+    def host_sendrecv(self, x, dest: int, source: int):
+        """Paired HOST-buffer send/recv (ref: the host point-to-point
+        role of comms_t::isend/irecv/waitall, core/comms.hpp:137-141 —
+        UCX-tagged transfers between rank host buffers, e.g. raft-dask
+        control payloads). ``x`` is a host array whose leading axis is
+        the per-rank send buffer (row r = rank r's payload); returns the
+        same layout with row r = what rank r received. The buffer hops
+        through the devices: staged sharded, one ppermute over the same
+        edge set as device_sendrecv (cross-host edges ride DCN under
+        jax.distributed), fetched back to host. Eager helper — call it
+        OUTSIDE shard_map bodies. One-sided *tagged* isend/irecv have no
+        mesh analog (no rendezvous peer in a single-controller program);
+        this paired form covers the transfer role — see docs/api_map.md.
+        """
+        from raft_tpu.core.error import expects
+        from raft_tpu.util.shard_map_compat import shard_map as _sm
+
+        expects(self.mesh is not None,
+                "host_sendrecv needs a mesh-bound Comms (build_comms)")
+        x = jnp.asarray(x)
+        expects(x.shape[0] == self.get_size(),
+                "leading axis must equal the comm size (one row per rank)")
+        sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(self.axis))
+        xd = jax.device_put(x, sharding)
+        fn = jax.jit(_sm(
+            lambda v: self.device_sendrecv(v, dest, source),
+            mesh=self.mesh,
+            in_specs=jax.sharding.PartitionSpec(self.axis),
+            out_specs=jax.sharding.PartitionSpec(self.axis)))
+        return np.asarray(jax.device_get(fn(xd)))
 
 
 def build_comms(mesh: jax.sharding.Mesh, axis: str = "data") -> Comms:

@@ -185,6 +185,39 @@ def test_pointToPoint_simple_send_recv(mesh: Mesh, axis: str = "data") -> bool:
     return _check(out, expect)
 
 
+def test_pointToPoint_device_multicast_sendrecv(mesh: Mesh,
+                                                axis: str = "data") -> bool:
+    """All-pairs multicast: rank r sends payload r·n+j to rank j (ref:
+    test_pointToPoint_device_multicast_sendrecv — a NCCL send/recv
+    group; here one all_to_all). Rank r must end with column r of the
+    payload matrix."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+
+    def body(x):
+        r = comms.get_rank().astype(jnp.float32)
+        mine = r * n + jnp.arange(n, dtype=jnp.float32)  # (n,) slab j → rank j
+        return comms.device_multicast_sendrecv(mine[:, None], axis=0)[None]
+
+    out = _run(mesh, axis, body, (P(axis),), P(axis),
+               _zeros(mesh, (n,), P(axis)))
+    expect = (np.arange(n)[:, None] * 0 + np.arange(n)[None, :] * n
+              + np.arange(n)[:, None]).astype(np.float32)[..., None]
+    return _check(out, expect)
+
+
+def test_pointToPoint_host_sendrecv(mesh: Mesh, axis: str = "data") -> bool:
+    """Host-buffer paired send/recv: the eager facade must route each
+    rank's host row through the device edge set and land the permuted
+    rows back on the host (ref: the UCX host p2p role of isend/irecv)."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+    payload = np.arange(n, dtype=np.float32)[:, None] * 10.0
+    out = comms.host_sendrecv(payload, dest=1, source=0)
+    expect = payload[(np.arange(n) - 1) % n]
+    return bool(np.allclose(out, expect))
+
+
 def test_commsplit(mesh2d: Mesh, row_axis: str = "rows",
                    col_axis: str = "cols") -> bool:
     """Sub-communicator over one axis of a 2-D mesh (ref: test_commsplit —

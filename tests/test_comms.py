@@ -51,6 +51,12 @@ class TestCollectives:
     def test_send_recv(self, mesh):
         assert comms_mod.test_pointToPoint_simple_send_recv(mesh)
 
+    def test_device_multicast_sendrecv(self, mesh):
+        assert comms_mod.test_pointToPoint_device_multicast_sendrecv(mesh)
+
+    def test_host_sendrecv(self, mesh):
+        assert comms_mod.test_pointToPoint_host_sendrecv(mesh)
+
     def test_commsplit(self, mesh2d):
         assert comms_mod.test_commsplit(mesh2d)
 
