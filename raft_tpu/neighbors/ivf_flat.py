@@ -127,6 +127,18 @@ class Index:
     adaptive_centers: bool = False
     conservative_memory_allocation: bool = False
 
+    def __post_init__(self):
+        # Cross-tensor shape consistency at construction: a corrupted or
+        # hand-assembled index fails HERE, not with silently wrong
+        # neighbors at search time (shapes are static even under jit).
+        expects(self.data.shape[0] == self.indices.shape[0]
+                == self.list_sizes.shape[0] == self.centers.shape[0],
+                "n_lists mismatch across index tensors")
+        expects(self.data.shape[1] == self.indices.shape[1],
+                "list capacity mismatch between data and indices")
+        expects(self.data.shape[2] == self.centers.shape[1],
+                "dim mismatch between data and centers")
+
     @property
     def n_lists(self) -> int:
         return self.centers.shape[0]

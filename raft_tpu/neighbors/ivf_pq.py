@@ -237,8 +237,17 @@ class Index:
     def __post_init__(self):
         # pq_dim is load-bearing (codes are bit-packed, so it is no longer
         # derivable from pq_codes.shape) — fail at construction, not at the
-        # first pq_len division.
+        # first pq_len division. The cross-tensor checks make a corrupted
+        # file fail HERE instead of searching silently wrong.
         expects(self.pq_dim > 0, "Index requires pq_dim > 0")
+        expects(self.pq_codes.shape[0] == self.indices.shape[0]
+                == self.list_sizes.shape[0] == self.centers.shape[0],
+                "n_lists mismatch across index tensors")
+        expects(self.pq_codes.shape[1] == self.indices.shape[1],
+                "list capacity mismatch between pq_codes and indices")
+        expects(self.pq_codes.shape[2]
+                == packed_row_bytes(self.pq_dim, self.pq_bits),
+                "pq_codes row bytes inconsistent with pq_dim/pq_bits")
 
     @property
     def n_lists(self) -> int:

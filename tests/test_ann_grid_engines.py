@@ -1,0 +1,228 @@
+"""Metric × ENGINE recall grids — every search tier must clear the same
+recall bound the scan engine does, for every supported metric.
+
+The round-4 polarity bug (cosine/correlation kNN returning the FARTHEST
+rows) lived exactly in the metric × engine cross product the original
+grid (test_ann_grid.py, scan engine only) never exercised: a tier that
+negates scores for min-selection (cells/compressed kernels) or scores a
+reconstruction (recon tier) can silently flip or shift polarity while
+L2-only tests stay green. Ref grid shape: cpp/test/neighbors/
+ann_ivf_pq.cuh:387-525 (enum_variety × metric), ann_ivf_flat.cuh:111.
+
+Polarity is asserted two ways per cell: recall against brute force, and
+an explicit best-vs-worst margin (the mean returned distance must be
+closer to the true nearest than to the true farthest — a pure polarity
+flip fails this even when recall-by-tie accidentally passes).
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.distance.distance_types import DistanceType
+from raft_tpu.neighbors import brute_force, ivf_flat, ivf_pq
+
+N_DB, N_Q, DIM, K = 4096, 256, 64, 10
+N_LISTS, N_PROBES = 32, 16
+
+
+def _recall(found, truth):
+    n, k = truth.shape
+    return sum(len(np.intersect1d(found[r], truth[r]))
+               for r in range(n)) / (n * k)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    db = rng.uniform(0.1, 2.0, (N_DB, DIM)).astype(np.float32)
+    q = rng.uniform(0.1, 2.0, (N_Q, DIM)).astype(np.float32)
+    return db, q
+
+
+def _truth(db, q, metric):
+    d, i = brute_force.knn(db, q, K, metric=metric)
+    return np.asarray(d), np.asarray(i)
+
+
+def _polarity_margin(db, q, found_ids, metric):
+    """Mean exact distance of the returned ids vs the true farthest-K
+    mean: a polarity-flipped engine returns (near-)farthest rows and
+    fails the margin even if ties rescue its recall."""
+    qf = q.astype(np.float64)
+    dbf = db.astype(np.float64)
+    if metric == DistanceType.InnerProduct:
+        full = qf @ dbf.T
+        best_mean = np.sort(full, axis=1)[:, -K:].mean()
+        worst_mean = np.sort(full, axis=1)[:, :K].mean()
+        got = np.take_along_axis(full, found_ids, axis=1).mean()
+        return (got - worst_mean) / max(best_mean - worst_mean, 1e-12)
+    full = ((qf ** 2).sum(1)[:, None] + (dbf ** 2).sum(1)[None, :]
+            - 2.0 * qf @ dbf.T)
+    best_mean = np.sort(full, axis=1)[:, :K].mean()
+    worst_mean = np.sort(full, axis=1)[:, -K:].mean()
+    got = np.take_along_axis(full, np.maximum(found_ids, 0), axis=1).mean()
+    return (worst_mean - got) / max(worst_mean - best_mean, 1e-12)
+
+
+FLAT_METRICS = [
+    ("l2", DistanceType.L2Expanded),
+    ("l2_sqrt", DistanceType.L2SqrtExpanded),
+    ("ip", DistanceType.InnerProduct),
+]
+# engine=(name, SearchParams kwargs). bucket_cap=0 + "bucketed" → cells
+# tier (interpret mode off-TPU); explicit bucket_cap → legacy bucket
+# table; "scan" → per-query gather scan.
+FLAT_ENGINES = [
+    ("scan", dict(engine="scan")),
+    ("cells", dict(engine="bucketed")),
+    ("bucket_table", dict(engine="bucketed", bucket_cap=N_Q)),
+]
+
+
+class TestIvfFlatMetricEngineGrid:
+    @pytest.mark.parametrize("ename,ekw", FLAT_ENGINES,
+                             ids=[e[0] for e in FLAT_ENGINES])
+    @pytest.mark.parametrize("mname,metric", FLAT_METRICS,
+                             ids=[m[0] for m in FLAT_METRICS])
+    def test_recall_and_polarity(self, data, mname, metric, ename, ekw):
+        db, q = data
+        gt_d, gt_i = _truth(db, q, metric)
+        params = ivf_flat.IndexParams(n_lists=N_LISTS, metric=metric,
+                                      kmeans_trainset_fraction=1.0)
+        index = ivf_flat.build(params, db)
+        sp = ivf_flat.SearchParams(n_probes=N_PROBES, **ekw)
+        d, i = ivf_flat.search(sp, index, q, K)
+        i = np.asarray(i)
+        rec = _recall(i, gt_i)
+        assert rec >= N_PROBES / N_LISTS, (mname, ename, rec)
+        margin = _polarity_margin(db, q, i, metric)
+        assert margin > 0.9, (mname, ename, margin)
+        # Distance VALUES must be monotone in the engine's advertised
+        # order (best-first), another polarity tripwire.
+        d = np.asarray(d)
+        if metric == DistanceType.InnerProduct:
+            assert np.all(np.diff(d, axis=1) <= 1e-4)
+        else:
+            assert np.all(np.diff(d, axis=1) >= -1e-4)
+
+
+PQ_METRICS = [
+    ("l2", DistanceType.L2Expanded),
+    ("l2_sqrt", DistanceType.L2SqrtExpanded),
+    ("ip", DistanceType.InnerProduct),
+]
+PQ_ENGINES = [
+    ("lut_scan", dict(engine="scan"), {}),
+    # bucketed + bucket_cap=0 → compressed cells tier (pq_fused_scan).
+    ("compressed", dict(engine="bucketed"), {}),
+    # bucketed + a pre-built recon cache → recon tier (fused_batch_knn
+    # over the bf16 reconstruction).
+    ("recon", dict(engine="bucketed", bucket_cap=N_Q), dict(recon=True)),
+]
+
+
+class TestIvfPqMetricEngineGrid:
+    @pytest.mark.parametrize("ename,ekw,flags", PQ_ENGINES,
+                             ids=[e[0] for e in PQ_ENGINES])
+    @pytest.mark.parametrize("mname,metric", PQ_METRICS,
+                             ids=[m[0] for m in PQ_METRICS])
+    def test_recall_and_polarity(self, data, mname, metric, ename, ekw,
+                                 flags):
+        db, q = data
+        gt_d, gt_i = _truth(db, q, metric)
+        params = ivf_pq.IndexParams(n_lists=N_LISTS, metric=metric,
+                                    kmeans_trainset_fraction=1.0)
+        index = ivf_pq.build(params, db)
+        if flags.get("recon"):
+            index.reconstructed()
+        sp = ivf_pq.SearchParams(n_probes=N_PROBES, **ekw)
+        d, i = ivf_pq.search(sp, index, q, K)
+        i = np.asarray(i)
+        # PQ quantization costs recall; the probe-coverage bound scaled
+        # by the pq6-class floor of the reference grid (0.84/0.86).
+        rec = _recall(i, gt_i)
+        assert rec >= (N_PROBES / N_LISTS) * 0.75, (mname, ename, rec)
+        margin = _polarity_margin(db, q, i, metric)
+        assert margin > 0.85, (mname, ename, margin)
+
+    @pytest.mark.parametrize("mname,metric", PQ_METRICS,
+                             ids=[m[0] for m in PQ_METRICS])
+    def test_engines_agree(self, data, mname, metric):
+        """All tiers score the same math (ADC ≡ ‖R·q − recon‖²): their
+        top-K sets must largely agree, not just clear a loose bound."""
+        db, q = data
+        params = ivf_pq.IndexParams(n_lists=N_LISTS, metric=metric,
+                                    kmeans_trainset_fraction=1.0)
+        index = ivf_pq.build(params, db)
+        _, i_scan = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=N_PROBES, engine="scan"),
+            index, q, K)
+        _, i_comp = ivf_pq.search(
+            ivf_pq.SearchParams(n_probes=N_PROBES, engine="bucketed"),
+            index, q, K)
+        agree = _recall(np.asarray(i_comp), np.asarray(i_scan))
+        assert agree > 0.9, (mname, agree)
+
+
+class TestBruteForceMetricPolarity:
+    """brute_force.knn polarity for the similarity-form metrics the
+    round-4 bug hit (cosine/correlation return distance form: smallest
+    = most similar)."""
+
+    METRICS = [
+        ("cosine", DistanceType.CosineExpanded),
+        ("correlation", DistanceType.CorrelationExpanded),
+        ("ip", DistanceType.InnerProduct),
+        ("l1", DistanceType.L1),
+    ]
+
+    @pytest.mark.parametrize("mname,metric", METRICS,
+                             ids=[m[0] for m in METRICS])
+    def test_nearest_not_farthest(self, data, mname, metric):
+        from raft_tpu.distance.pairwise import distance as pairwise
+
+        db, q = data
+        d, i = brute_force.knn(db, q[:64], K, metric=metric)
+        i = np.asarray(i)
+        full = np.asarray(pairwise(q[:64], db, metric=metric))
+        if metric == DistanceType.InnerProduct:
+            truth = np.argsort(-full, axis=1)[:, :K]
+        else:
+            truth = np.argsort(full, axis=1)[:, :K]
+        rec = _recall(i, truth)
+        assert rec > 0.99, (mname, rec)
+
+
+class TestRefineMetricPolarity:
+    """refine() re-ranks with exact distances — its polarity must match
+    the metric's value form for every supported metric (the second site
+    of the round-4 bug class)."""
+
+    METRICS = [
+        ("l2", DistanceType.L2Expanded),
+        ("cosine", DistanceType.CosineExpanded),
+        ("ip", DistanceType.InnerProduct),
+        ("l1", DistanceType.L1),
+    ]
+
+    @pytest.mark.parametrize("mname,metric", METRICS,
+                             ids=[m[0] for m in METRICS])
+    def test_refine_picks_nearest_of_pool(self, data, mname, metric):
+        from raft_tpu.distance.pairwise import distance as pairwise
+        from raft_tpu.neighbors.refine import refine
+
+        db, q = data
+        q = q[:64]
+        rng = np.random.default_rng(3)
+        # Candidate pool = true top-3K shuffled + noise ids: refine must
+        # recover the exact top-K from it.
+        full = np.asarray(pairwise(q, db, metric=metric))
+        order = (np.argsort(-full, axis=1)
+                 if metric == DistanceType.InnerProduct
+                 else np.argsort(full, axis=1))
+        pool = order[:, :3 * K].copy()
+        rng.permuted(pool, axis=1, out=pool)
+        d, i = refine(db, q, pool, K, metric=metric)
+        truth = order[:, :K]
+        rec = _recall(np.asarray(i), truth)
+        assert rec > 0.99, (mname, rec)

@@ -1,0 +1,268 @@
+"""Randomized-shape property tests for the Pallas kernels against numpy
+oracles (interpret mode on CPU — the shapes are drawn fresh per seed, so
+the kernels' padding/masking/queue logic is exercised across the whole
+legal envelope, not just the bench shapes; VERDICT r4 item 3 / r5 item 3).
+
+Oracle style: cpp/test/matrix/select_k.cu and neighbors/ann_utils.cuh
+compare against naive host references the same way.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.matrix.select_k import SelectMethod, select_k
+
+
+def _naive_topk_min(vals, k):
+    """Ascending top-k with lax.top_k's tie rule (lowest index wins)."""
+    idx = np.argsort(vals, axis=1, kind="stable")[:, :k]
+    return np.take_along_axis(vals, idx, axis=1), idx
+
+
+class TestSelectKProperties:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_shapes_vs_oracle(self, seed):
+        rng = np.random.default_rng(seed)
+        batch = int(rng.integers(1, 40))
+        n = int(rng.integers(2, 5000))
+        k = int(rng.integers(1, min(n, 257)))
+        v = rng.normal(size=(batch, n)).astype(np.float32)
+        # inject ties and extremes
+        if n > 10:
+            v[:, rng.integers(0, n, 5)] = v[:, 0][:, None]
+        sel, idx = select_k(jnp.asarray(v), k, select_min=True)
+        want_v, _ = _naive_topk_min(v, k)
+        np.testing.assert_allclose(np.asarray(sel), want_v, rtol=1e-6)
+        # returned indices must address the returned values
+        np.testing.assert_allclose(
+            np.take_along_axis(v, np.asarray(idx), axis=1), want_v,
+            rtol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_select_max_polarity(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        batch, n = int(rng.integers(1, 16)), int(rng.integers(8, 2000))
+        k = int(rng.integers(1, min(n, 129)))
+        v = rng.normal(size=(batch, n)).astype(np.float32)
+        sel, idx = select_k(jnp.asarray(v), k, select_min=False)
+        want = -_naive_topk_min(-v, k)[0]
+        np.testing.assert_allclose(np.asarray(sel), want, rtol=1e-6)
+
+    @pytest.mark.parametrize("method", [SelectMethod.kTwoPhase,
+                                        SelectMethod.kTopK])
+    @pytest.mark.parametrize("seed", range(3))
+    def test_explicit_engines_agree(self, method, seed):
+        rng = np.random.default_rng(200 + seed)
+        batch, n = int(rng.integers(2, 24)), int(rng.integers(64, 8000))
+        k = int(rng.integers(1, 64))
+        v = rng.normal(size=(batch, n)).astype(np.float32)
+        sel, _ = select_k(jnp.asarray(v), k, select_min=True, method=method)
+        want, _ = _naive_topk_min(v, k)
+        np.testing.assert_allclose(np.asarray(sel), want, rtol=1e-6)
+
+    def test_pathological_rows(self):
+        """Sorted, constant, inf-heavy and NaN-free degenerate rows (the
+        audit/fallback paths of the stream engine)."""
+        n, k = 4096, 32
+        rows = [
+            np.arange(n, dtype=np.float32),            # ascending
+            np.arange(n, dtype=np.float32)[::-1],      # descending
+            np.zeros(n, np.float32),                   # constant
+            np.where(np.arange(n) % 2 == 0, np.inf,
+                     np.arange(n)).astype(np.float32),  # half inf
+        ]
+        v = np.stack(rows)
+        sel, idx = select_k(jnp.asarray(v), k, select_min=True)
+        want, _ = _naive_topk_min(v, k)
+        np.testing.assert_allclose(np.asarray(sel), want)
+
+    def test_integer_payload_indices(self):
+        rng = np.random.default_rng(5)
+        v = rng.normal(size=(8, 500)).astype(np.float32)
+        payload = rng.integers(0, 10**6, size=(8, 500)).astype(np.int32)
+        sel, ids = select_k(jnp.asarray(v), 10, select_min=True,
+                            indices=jnp.asarray(payload))
+        _, pos = _naive_topk_min(v, 10)
+        np.testing.assert_array_equal(
+            np.asarray(ids), np.take_along_axis(payload, pos, axis=1))
+
+
+class TestFusedCellsKnnProperties:
+    """fused_cells_knn in interpret mode against a per-cell numpy scan."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_cells_vs_oracle(self, seed):
+        from raft_tpu.ops.fused_knn import fused_cells_knn
+
+        rng = np.random.default_rng(300 + seed)
+        n_lists = int(rng.integers(2, 9))
+        cap = int(rng.integers(4, 200))
+        d = int(rng.integers(3, 80))
+        qrows = int(rng.integers(2, 17))
+        max_cells = int(rng.integers(2, 7))
+        k = int(rng.integers(1, min(cap, 140) + 1))
+        l2 = bool(rng.integers(0, 2))
+
+        db = rng.normal(size=(n_lists, cap, d)).astype(np.float32)
+        sizes = rng.integers(0, cap + 1, size=n_lists)
+        invalid = np.arange(cap)[None, :] >= sizes[:, None]
+        Q = rng.normal(size=(max_cells, qrows, d)).astype(np.float32)
+        cell_list = rng.integers(-1, n_lists, size=max_cells).astype(
+            np.int32)
+
+        bd, bi = fused_cells_knn(
+            jnp.asarray(cell_list), jnp.asarray(Q), jnp.asarray(db),
+            jnp.asarray(invalid), k, l2=l2, interpret=True)
+        bd, bi = np.asarray(bd), np.asarray(bi)
+
+        for c in range(max_cells):
+            li = cell_list[c]
+            if li < 0:
+                assert np.all(np.isinf(bd[c])) and np.all(bi[c] == -1)
+                continue
+            if l2:
+                dist = ((Q[c][:, None, :].astype(np.float64)
+                         - db[li][None].astype(np.float64)) ** 2).sum(-1)
+            else:
+                dist = -(Q[c].astype(np.float64)
+                         @ db[li].astype(np.float64).T)
+            dist = np.where(invalid[li][None, :], np.inf, dist)
+            want = np.sort(dist, axis=1)[:, :k]
+            got = bd[c].astype(np.float64)
+            finite = np.isfinite(want)
+            np.testing.assert_allclose(got[finite], want[finite],
+                                       rtol=2e-2, atol=1e-3)
+            # starved slots carry the -1 sentinel
+            assert np.all(bi[c][~np.isfinite(got)] == -1)
+            # returned ids address rows at the claimed distances
+            for r in range(qrows):
+                for j in range(k):
+                    if bi[c][r, j] >= 0:
+                        assert not invalid[li][bi[c][r, j]]
+
+    def test_k_above_128_two_lane_groups(self):
+        """k in (128, 256]: the widened queue (VERDICT r5 item 4)."""
+        from raft_tpu.ops.fused_knn import fused_cells_knn
+
+        rng = np.random.default_rng(9)
+        n_lists, cap, d, qrows, k = 3, 300, 16, 8, 200
+        db = rng.normal(size=(n_lists, cap, d)).astype(np.float32)
+        invalid = np.zeros((n_lists, cap), bool)
+        Q = rng.normal(size=(2, qrows, d)).astype(np.float32)
+        cells = np.array([0, 2], np.int32)
+        bd, bi = fused_cells_knn(jnp.asarray(cells), jnp.asarray(Q),
+                                 jnp.asarray(db), jnp.asarray(invalid),
+                                 k, l2=True, interpret=True)
+        for c, li in enumerate(cells):
+            dist = ((Q[c][:, None, :] - db[li][None]) ** 2).sum(-1)
+            want = np.sort(dist, axis=1)[:, :k]
+            np.testing.assert_allclose(np.asarray(bd)[c], want, rtol=2e-2,
+                                       atol=1e-3)
+
+
+class TestPqFusedScanProperties:
+    """pq_fused_scan in interpret mode against a decode-then-score numpy
+    oracle (the ADC identity: score = ‖rot_q − (center_rot + codeword)‖²)."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    @pytest.mark.parametrize("pq_bits", [4, 8])
+    def test_random_shapes_vs_oracle(self, seed, pq_bits):
+        from raft_tpu.neighbors.ivf_pq import pack_codes
+        from raft_tpu.ops.pq_scan import (absolute_book_tables,
+                                          permute_subspaces, pq_fused_scan)
+
+        rng = np.random.default_rng(400 + seed)
+        n_lists = int(rng.integers(2, 6))
+        J = int(rng.integers(1, 5)) * (2 if pq_bits == 4 else 1)
+        L = int(rng.integers(1, 4))
+        rot = J * L
+        cap = int(rng.integers(8, 120))
+        qrows = int(rng.integers(2, 12))
+        max_cells = int(rng.integers(2, 5))
+        k = int(rng.integers(1, min(cap, 100) + 1))
+        B = 1 << pq_bits
+
+        books = rng.normal(size=(J, B, L)).astype(np.float32)
+        centers_rot = rng.normal(size=(n_lists, rot)).astype(np.float32)
+        codes = rng.integers(0, B, size=(n_lists, cap, J))
+        packed = np.asarray(pack_codes(jnp.asarray(codes), pq_bits))
+        codesT = np.swapaxes(packed, 1, 2)
+        sizes = rng.integers(1, cap + 1, size=n_lists)
+        invalid = np.arange(cap)[None, :] >= sizes[:, None]
+        rotq = rng.normal(size=(max_cells, qrows, rot)).astype(np.float32)
+        cell_list = rng.integers(0, n_lists, size=max_cells).astype(
+            np.int32)
+
+        crot_p = permute_subspaces(jnp.asarray(centers_rot), J, pq_bits)
+        lo, hi = absolute_book_tables(jnp.asarray(books), crot_p, pq_bits)
+        rotq_p = np.asarray(permute_subspaces(jnp.asarray(rotq), J,
+                                              pq_bits))
+        bd, bi = pq_fused_scan(
+            jnp.asarray(cell_list), jnp.asarray(rotq_p),
+            jnp.asarray(codesT), lo, hi, jnp.asarray(invalid),
+            k, J, pq_bits, False, interpret=True)
+        bd, bi = np.asarray(bd), np.asarray(bi)
+
+        # numpy decode: absolute reconstruction per slot
+        recon = (books[np.arange(J)[None, None, :], codes]
+                 .reshape(n_lists, cap, rot)
+                 + centers_rot[:, None, :])
+        for c in range(max_cells):
+            li = cell_list[c]
+            dist = (((rotq[c][:, None, :].astype(np.float64)
+                      - recon[li][None].astype(np.float64)) ** 2)
+                    .sum(-1))
+            dist = np.where(invalid[li][None, :], np.inf, dist)
+            want = np.sort(dist, axis=1)[:, :k]
+            got = bd[c].astype(np.float64)
+            finite = np.isfinite(want)
+            # bf16 MXU scoring: loose relative tolerance on values, but
+            # the SET of selected slots must be near-exact.
+            np.testing.assert_allclose(got[finite], want[finite],
+                                       rtol=5e-2, atol=5e-2)
+            # Tie-aware id check (bf16 scoring may swap near-tied ranks;
+            # eval_neighbours-style, ann_utils.cuh:121-162): every
+            # selected slot's TRUE distance must be within tolerance of
+            # the true k-th best.
+            for r in range(min(qrows, 4)):
+                edge = want[r][np.isfinite(want[r])]
+                if edge.size == 0:
+                    continue
+                edge = edge[-1]
+                for x in bi[c][r]:
+                    if x >= 0:
+                        assert dist[r][int(x)] <= edge * 1.05 + 0.05, \
+                            (c, r, int(x))
+
+    def test_ip_polarity(self):
+        """is_ip=True must report NEGATED inner products (min-select
+        order) of the reconstruction — the polarity contract the cells
+        routing depends on."""
+        from raft_tpu.neighbors.ivf_pq import pack_codes
+        from raft_tpu.ops.pq_scan import (absolute_book_tables,
+                                          permute_subspaces, pq_fused_scan)
+
+        rng = np.random.default_rng(77)
+        n_lists, J, L, cap, qrows, k = 2, 2, 2, 32, 4, 5
+        rot, B = J * L, 256
+        books = rng.normal(size=(J, B, L)).astype(np.float32)
+        centers_rot = rng.normal(size=(n_lists, rot)).astype(np.float32)
+        codes = rng.integers(0, B, size=(n_lists, cap, J))
+        codesT = np.swapaxes(np.asarray(pack_codes(jnp.asarray(codes), 8)),
+                             1, 2)
+        invalid = np.zeros((n_lists, cap), bool)
+        rotq = rng.normal(size=(1, qrows, rot)).astype(np.float32)
+        lo, hi = absolute_book_tables(jnp.asarray(books),
+                                      jnp.asarray(centers_rot), 8)
+        bd, bi = pq_fused_scan(
+            jnp.asarray([1], dtype=jnp.int32), jnp.asarray(rotq),
+            jnp.asarray(codesT), lo, hi, jnp.asarray(invalid),
+            k, J, 8, True, interpret=True)
+        recon = (books[np.arange(J)[None, None, :], codes]
+                 .reshape(n_lists, cap, rot) + centers_rot[:, None, :])
+        scores = rotq[0] @ recon[1].T
+        want = -np.sort(-scores, axis=1)[:, :k]     # best (largest) first
+        np.testing.assert_allclose(-np.asarray(bd)[0], want, rtol=5e-2,
+                                   atol=5e-2)
