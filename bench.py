@@ -502,17 +502,20 @@ def _family_sift1m_u8():
           recall_at_10=round(rec, 3), n_probes=32,
           spread_pct=round(spread, 1))
 
-    # The real-format dataset at the 0.86 class (VERDICT r5 item 5b):
-    # recall-class request -> internal exact refine against the
-    # u8 dataset the index retains.
+    # The real-format dataset through the refine recipe (VERDICT r5
+    # item 5b). SIFT-shaped clustered data concentrates the true pool
+    # in the query's own list, so the robust recall class (> 0.9:
+    # unbounded pool-deep queue) is the one that demonstrates the
+    # recipe here — the fast bounded class is a structureless-regime
+    # recipe (see ivf_pq._compressed_search).
     spr = ivf_pq.SearchParams(n_probes=32, engine="bucketed",
-                              bucket_cap=256, min_recall=0.86)
+                              bucket_cap=256, min_recall=0.95)
     _, i = ivf_pq.search(spr, pidx, Q, 10)
     rec = _recall(np.asarray(i), truth)
     qps, spread = _eager_qps(
         lambda q: ivf_pq.search(spr, pidx, q, 10), Q, reps=12)
     _emit("ivf_pq_sift1m_u8_qps_refined", qps, "qps", 1.0,
-          recall_at_10=round(rec, 3), min_recall=0.86,
+          recall_at_10=round(rec, 3), min_recall=0.95,
           engine="compressed+refine", spread_pct=round(spread, 1))
     del pidx
 
@@ -544,8 +547,12 @@ def _family_10m():
     truth = np.asarray(ti)
 
     t0 = time.perf_counter()
+    # trainset_fraction 0.05 = 500K training rows (ample for 4096
+    # clusters); the default 0.5 would stage a 2.6 GB trainset copy next
+    # to the 5.1 GB dataset and OOM the 16 GB chip.
     pidx = ivf_pq.build(
-        ivf_pq.IndexParams(n_lists=4096, retain_dataset=False), X)
+        ivf_pq.IndexParams(n_lists=4096, retain_dataset=False,
+                           kmeans_trainset_fraction=0.05), X)
     fence(pidx.pq_codes)
     build_s = time.perf_counter() - t0
     del X  # the index retains nothing — codes + model only
@@ -631,43 +638,35 @@ def _headline():
     _emit("bf_knn_sift10k_qps", qps, "qps", qps / cpu_qps)
 
 
+def _run_family(fn, error_metric):
+    """Run one bench family; failures emit an error row instead of
+    killing the rest. The exception (whose traceback frames pin the
+    family's device arrays — observed: a 10M family OOM kept 5 GB alive
+    and then OOM'd the HEADLINE) is cleared and the frames collected
+    before the next family runs."""
+    import gc
+
+    try:
+        fn()
+    except Exception as e:
+        print(json.dumps({"metric": error_metric,
+                          "value": 0.0, "unit": "", "vs_baseline": 0.0,
+                          "error": repr(e)[:200]}), flush=True)
+    gc.collect()
+
+
 def main():
     # Persistent XLA cache: round-over-round bench runs skip recompilation
     # (the precompiled-instantiation role of the reference's libraft.so).
     from raft_tpu.core.compilation_cache import enable_compilation_cache
 
     enable_compilation_cache()
-    try:
-        _family()
-    except Exception as e:  # family failures must not kill the headline
-        print(json.dumps({"metric": "bench_family_error",
-                          "value": 0.0, "unit": "", "vs_baseline": 0.0,
-                          "error": repr(e)[:200]}), flush=True)
+    _run_family(_family, "bench_family_error")
     if "--no-1m" not in sys.argv:
-        try:
-            _family_1m()
-        except Exception as e:
-            print(json.dumps({"metric": "bench_1m_error",
-                              "value": 0.0, "unit": "", "vs_baseline": 0.0,
-                              "error": repr(e)[:200]}), flush=True)
-        try:
-            _family_sift1m_u8()
-        except Exception as e:
-            print(json.dumps({"metric": "bench_sift1m_error",
-                              "value": 0.0, "unit": "", "vs_baseline": 0.0,
-                              "error": repr(e)[:200]}), flush=True)
-        try:
-            _family_4m()
-        except Exception as e:
-            print(json.dumps({"metric": "bench_4m_error",
-                              "value": 0.0, "unit": "", "vs_baseline": 0.0,
-                              "error": repr(e)[:200]}), flush=True)
-        try:
-            _family_10m()
-        except Exception as e:
-            print(json.dumps({"metric": "bench_10m_error",
-                              "value": 0.0, "unit": "", "vs_baseline": 0.0,
-                              "error": repr(e)[:200]}), flush=True)
+        _run_family(_family_1m, "bench_1m_error")
+        _run_family(_family_sift1m_u8, "bench_sift1m_error")
+        _run_family(_family_4m, "bench_4m_error")
+        _run_family(_family_10m, "bench_10m_error")
     _headline()
 
 

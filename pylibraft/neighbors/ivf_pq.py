@@ -39,7 +39,8 @@ class IndexParams:
                  kmeans_n_iters=20, kmeans_trainset_fraction=0.5,
                  pq_bits=8, pq_dim=0, codebook_kind="subspace",
                  force_random_rotation=False, add_data_on_build=True,
-                 conservative_memory_allocation=False, idx_dtype="int32"):
+                 conservative_memory_allocation=False, idx_dtype="int32",
+                 retain_dataset=True):
         if codebook_kind not in _CODEBOOK_KINDS:
             raise ValueError(f"codebook_kind must be in {sorted(_CODEBOOK_KINDS)}")
         self.params = _impl.IndexParams(
@@ -54,6 +55,7 @@ class IndexParams:
             add_data_on_build=add_data_on_build,
             idx_dtype=idx_dtype,
             conservative_memory_allocation=conservative_memory_allocation,
+            retain_dataset=retain_dataset,
         )
 
     @property
@@ -103,17 +105,21 @@ class SearchParams:
     internal_distance_dtype)."""
 
     def __init__(self, *, n_probes=20, lut_dtype=np.float32,
-                 internal_distance_dtype=np.float32):
+                 internal_distance_dtype=np.float32, min_recall=None):
         lut = _DTYPE_NAMES.get(str(lut_dtype), lut_dtype)
         internal = _DTYPE_NAMES.get(str(internal_distance_dtype),
                                     internal_distance_dtype)
         self.params = _impl.SearchParams(
             n_probes=n_probes, lut_dtype=lut,
-            internal_distance_dtype=internal)
+            internal_distance_dtype=internal, min_recall=min_recall)
 
     @property
     def n_probes(self):
         return self.params.n_probes
+
+    @property
+    def min_recall(self):
+        return self.params.min_recall
 
     @property
     def lut_dtype(self):

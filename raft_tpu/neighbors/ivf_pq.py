@@ -560,10 +560,19 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
 
     ``cell_k`` < k bounds the per-(query, probe) queue at cell_k while
     the final merge still keeps k of the pooled n_probes·cell_k
-    candidates — the over-retrieve mode of :func:`search_refined` (the
-    pool is a candidate set for exact re-ranking, so it need not be the
-    exact top-k; the in-kernel queue cost is linear in its k). 0 means
-    exact (cell_k = k)."""
+    candidates — the FAST over-retrieve mode of :func:`search_refined`
+    (the in-kernel queue cost is linear in its k). 0 means exact
+    (cell_k = k). The bound is a REGIME trade-off: on clustered data
+    the whole true top-pool can live in the query's best list, where a
+    per-probe top-cell_k forfeits it (measured at 1M: SIFT-u8 refined
+    recall froze at 0.814 for ratio 2→16 under the bound, vs 0.974
+    unbounded at ratio 2; structureless queries spread the pool over
+    probes and lose nothing — 0.924 vs 0.933). A rank-split two-launch
+    variant (pool-deep queue for the best 2 probe ranks only) was built
+    and measured NO better than unbounding everything — a 2-of-48-rank
+    launch alone cost 82 ms vs the full 48-rank launch's 104 ms, the
+    per-launch floor dominating — so the dispatch stays single-launch
+    and search() maps recall classes to the bound instead."""
     from raft_tpu.ops.pq_scan import permute_subspaces, pq_fused_scan
 
     q = Q.shape[0]
@@ -1126,20 +1135,27 @@ def search(
     # Recall-class request above the native PQ ceiling: run the exact-
     # refine recipe internally (the reference pairs ivf_pq with
     # neighbors/refine.cuh the same way; here the engine dispatch does
-    # it so the caller never spells "refined"). The (n_probes, ratio)
-    # mapping is measured on the 1M regimes: native saturates ~0.83
-    # uniform; n_probes>=48 + ratio 2 reaches 0.92-class, ratio 4 +
-    # n_probes>=64 the 0.95-class (BASELINE.md).
+    # it so the caller never spells "refined"). The mapping, measured
+    # on the 1M regimes (BASELINE.md round 5):
+    #   (0.84, 0.9] → n_probes≥48, ratio 2, BOUNDED per-cell queue —
+    #       the fast class (~9.4K QPS @ 0.92 uniform); on heavily
+    #       clustered data the bound caps recall near the native class
+    #       (SIFT-u8 0.814) — request > 0.9 there.
+    #   > 0.9      → n_probes≥64, ratio 4, UNBOUNDED queue — the
+    #       robust class (0.997 SIFT-u8 / 0.94-class uniform at ~0.4×
+    #       the fast class's QPS).
     if (params.min_recall is not None
             and params.min_recall > _REFINE_RECALL_CLASS):
         if index._source is not None:
             import dataclasses
-            ratio = 4 if params.min_recall >= 0.95 else 2
+            robust = params.min_recall > 0.9
+            ratio = 4 if robust else 2
             sp = dataclasses.replace(
                 params, min_recall=None,
-                n_probes=max(params.n_probes, 64 if ratio == 4 else 48))
+                n_probes=max(params.n_probes, 64 if robust else 48))
             return search_refined(sp, index, index._source, queries, k,
-                                  refine_ratio=ratio, handle=handle)
+                                  refine_ratio=ratio, handle=handle,
+                                  bound_queue=not robust)
         from raft_tpu.core.logger import logger
         logger.warning(
             "min_recall=%.2f requested but the index retains no source "
@@ -1241,7 +1257,7 @@ def search(
 @traced
 def search_refined(
     params: SearchParams, index: Index, dataset, queries, k: int,
-    refine_ratio: int = 2, handle=None,
+    refine_ratio: int = 2, handle=None, bound_queue: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Over-retrieve ``refine_ratio·k`` PQ candidates and exact-refine to
     k against ``dataset`` — the reference's standard recipe for lifting
@@ -1256,6 +1272,12 @@ def search_refined(
     batch. Returns ``(distances, neighbors)`` like :func:`search`.
     Callers can request this recipe implicitly via
     ``SearchParams.min_recall`` instead.
+
+    ``bound_queue`` (compressed fast path only): True keeps each
+    (query, probe) cell's in-kernel queue at k — ~1.7× the QPS, but on
+    heavily clustered data the best list can hold the whole true pool
+    and the bound caps recall near the native class (see
+    _compressed_search); False scans every cell pool-deep.
     """
     from raft_tpu.neighbors.refine import refine
 
@@ -1285,11 +1307,12 @@ def search_refined(
     # candidates instead of tripping refine's k <= n_candidates check.
     k = min(k, max(index.capacity, 1))
     pool = min(refine_ratio * k, max(index.capacity, 1))
-    # Compressed fast path with a bounded per-cell queue: the refine
-    # pool is a candidate set (exact re-rank follows), so each
-    # (query, probe) contributes its top-k only — the in-kernel queue
-    # cost stays that of k, not ratio·k (measured 6.1K → ~10K QPS at
-    # the 1M uniform config).
+    # Compressed fast path: the refine pool is a candidate set (exact
+    # re-rank follows), so with ``bound_queue`` each (query, probe)
+    # contributes its top-k only — the in-kernel queue cost stays that
+    # of k, not ratio·k (measured 6.1K → ~10K QPS at the 1M uniform
+    # config; the clustered-regime trade-off is documented on
+    # _compressed_search and driven by the min_recall mapping).
     if (pool <= n_probes * k and Q.ndim == 2 and Q.shape[1] == index.dim
             and _compressed_eligible(params, index, n_probes, pool,
                                      Q.shape[0], default_dtypes)):
@@ -1299,7 +1322,8 @@ def search_refined(
             abs_hi, invalid, index.indices, n_probes, pool, is_ip,
             index.pq_dim, index.pq_bits,
             min(_CELL_QROWS, max(8, Q.shape[0])),
-            jax.default_backend() != "tpu", min(k, pool))
+            jax.default_backend() != "tpu",
+            min(k, pool) if bound_queue else 0)
     else:
         _, i = search(params, index, queries, pool, handle=handle)
     return refine(dataset, queries, i, k, metric=index.metric)

@@ -111,7 +111,10 @@ def _kpass_merge(ad, ai, bd_, bi, k: int, kp: int):
         cur = jnp.min(cd, axis=1, keepdims=True)
         pos = jnp.min(jnp.where(cd == cur, col2, _I32MAX), axis=1, keepdims=True)
         chosen = col2 == pos
-        selid = jnp.sum(jnp.where(chosen, cati, 0), axis=1, keepdims=True)
+        # dtype pinned: under x64, integer jnp.sum otherwise promotes to
+        # int64 and breaks the fori_loop carry type.
+        selid = jnp.sum(jnp.where(chosen, cati, 0), axis=1, keepdims=True,
+                        dtype=jnp.int32)
         cd = jnp.where(chosen, jnp.inf, cd)
         put = colk == t
         nd = jnp.where(put, cur, nd)

@@ -53,6 +53,20 @@ d, i = ivf_pq.search(ivf_pq.SearchParams(n_probes=8, engine="scan"),
                      pidx, q, 5)
 assert i.dtype == jnp.int64, i.dtype
 
+# int64 ids through the packed-cells / compressed tiers (the id payload
+# gathers: indices[cell_list][bi], route, select_k payload — every hop
+# must keep the 64-bit dtype; engine="bucketed" forces the kernels in
+# interpret mode on CPU)
+d, ic = ivf_flat.search(ivf_flat.SearchParams(n_probes=8,
+                                              engine="bucketed"), idx, q, 5)
+assert ic.dtype == jnp.int64, ic.dtype
+d, i32ref = ivf_flat.search(ivf_flat.SearchParams(n_probes=8,
+                                                  engine="scan"), idx, q, 5)
+np.testing.assert_array_equal(np.asarray(ic), np.asarray(i32ref))
+d, ip = ivf_pq.search(ivf_pq.SearchParams(n_probes=8, engine="bucketed"),
+                      pidx, q, 5)
+assert ip.dtype == jnp.int64, ip.dtype
+
 # extend with explicit int64 ids beyond 2^31
 idx2 = ivf_flat.build(
     ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4, idx_dtype=jnp.int64,

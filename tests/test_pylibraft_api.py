@@ -171,6 +171,30 @@ class TestIvfPq:
         truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :10]
         assert _recall(np.asarray(n), truth) > 0.7
 
+    def test_min_recall_class_request(self, rng):
+        """The recall-class knob flows through the compat surface: a
+        min_recall above the native PQ class triggers the internal
+        exact-refine recipe."""
+        from pylibraft.neighbors import ivf_pq
+
+        db = rng.normal(size=(2000, 16)).astype(np.float32)
+        q = rng.normal(size=(50, 16)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, metric="sqeuclidean",
+                                    pq_dim=8, pq_bits=8)
+        index = ivf_pq.build(params, db)
+        sp = ivf_pq.SearchParams(n_probes=16, min_recall=0.86)
+        assert sp.min_recall == 0.86
+        d, n = ivf_pq.search(sp, index, q, 10)
+        truth = np.argsort(cdist(q, db, "sqeuclidean"), axis=1)[:, :10]
+        assert _recall(np.asarray(n), truth) > 0.86
+        # retain_dataset=False: the index keeps codes only; the request
+        # degrades to the native search (warning, not a crash).
+        p2 = ivf_pq.IndexParams(n_lists=16, metric="sqeuclidean",
+                                pq_dim=8, pq_bits=8, retain_dataset=False)
+        idx2 = ivf_pq.build(p2, db)
+        d2, n2 = ivf_pq.search(sp, idx2, q, 10)
+        assert _recall(np.asarray(n2), truth) > 0.5
+
     def test_search_with_refine(self, rng):
         from pylibraft.neighbors import ivf_pq, refine
 
