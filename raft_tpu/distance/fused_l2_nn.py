@@ -32,6 +32,9 @@ from raft_tpu.core.nvtx import traced
 # y-tile size: large enough to keep the MXU busy, small enough that the
 # (m, tile) epilogue stays in VMEM for typical m blocks.
 _TILE_N = 2048
+# Query-axis chunk of the Pallas kernel path: bounds the lane-padded
+# (chunk, 128) f32+i32 outputs (+ the padded query copy) at ~1.5 GB.
+_KERNEL_ROW_CHUNK = 1 << 20
 
 
 @traced
@@ -77,12 +80,31 @@ def fused_l2_nn_min_reduce(
             and tile_n == _TILE_N
             and precision in (DEFAULT_PRECISION, lax.Precision.HIGHEST)):
         # Pallas fused kernel (k=1 top-k queue): the (m, n) tile never
-        # leaves VMEM. Ref: detail/fused_l2_nn.cuh:129.
+        # leaves VMEM. Ref: detail/fused_l2_nn.cuh:129. The kernel's
+        # outputs are 128-lane padded — (m, 128) f32+i32 — so huge row
+        # counts chunk the query axis or the padding alone exhausts HBM
+        # (a 10M-row k-means assignment OOM'd at 14.3 GB of HLO temp).
         from raft_tpu.ops.fused_knn import fused_knn
 
-        d1, i1 = fused_knn(x, y, 1, metric="l2", sqrt=sqrt,
-                           bf16=bf16 is not None, qsplit=bf16 == "split")
-        return d1[:, 0], i1[:, 0]
+        def kernel(xc):
+            d1, i1 = fused_knn(xc, y, 1, metric="l2", sqrt=sqrt,
+                               bf16=bf16 is not None,
+                               qsplit=bf16 == "split")
+            return d1[:, 0], i1[:, 0]
+
+        if m <= _KERNEL_ROW_CHUNK:
+            return kernel(x)
+        outs = []
+        for s in range(0, m, _KERNEL_ROW_CHUNK):
+            xc = x[s:s + _KERNEL_ROW_CHUNK]
+            if xc.shape[0] < _KERNEL_ROW_CHUNK:
+                # Pad the tail with leading rows: one compiled chunk
+                # shape instead of a second trace of the ragged tail.
+                xc = jnp.concatenate(
+                    [xc, x[:_KERNEL_ROW_CHUNK - xc.shape[0]]])
+            outs.append(kernel(xc))
+        return (jnp.concatenate([o[0] for o in outs])[:m],
+                jnp.concatenate([o[1] for o in outs])[:m])
 
     def mm(a, bt):
         """x·yᵀ gram honoring the requested bf16 tier — the XLA fallback
