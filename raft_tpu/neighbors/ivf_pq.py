@@ -697,6 +697,11 @@ _REFINE_RECALL_CLASS = 0.84
 # Row cap for the OPQ alternation's sub-trainset (see build step 3b).
 _OPQ_TRAIN_ROWS = 100_000
 
+# Row-chunk length of the outer encode_rows loop (residual + encode +
+# pack per chunk; the inner distance blocks chunk further at
+# _ENCODE_CHUNK). Bounds the live residual tensor at ~64 MB.
+_ENCODE_ROWS = 1 << 17
+
 
 def _chunked_rows(fn, *arrays):
     """Apply ``fn(rows...) -> (chunk, pq_dim)`` over row chunks of equal
@@ -900,16 +905,38 @@ def encode_rows(model, X) -> Tuple[jax.Array, jax.Array]:
     ivf_pq_build.cuh:724) shared by ``extend``, the sharded build and the
     sharded extend — ``model`` is any object with centers /
     rotation_matrix / pq_centers / codebook_kind / pq_dim / pq_bits
-    (an Index or a ShardedIvfPq)."""
+    (an Index or a ShardedIvfPq).
+
+    The residual→encode→pack stages run per ROW CHUNK: a 10M-row build
+    would otherwise materialize the full (n, pq_dim, pq_len) f32
+    residual tensor (5.1 GB) next to the dataset and OOM the chip —
+    only the labels and the packed u8 code rows ever exist at full n
+    (the reference's process_and_fill_codes encodes as it packs for
+    the same reason)."""
     kb = KMeansBalancedParams(metric=DistanceType.L2Expanded)
     labels = kmeans_balanced.predict(kb, model.centers, X)
-    res = _residuals(X, labels, model.centers, model.rotation_matrix,
-                     model.pq_dim)
-    if model.codebook_kind == CodebookGen.PER_SUBSPACE:
-        codes = _encode(res, model.pq_centers)
-    else:
-        codes = _encode_per_cluster(res, labels, model.pq_centers)
-    return labels, pack_codes(codes, model.pq_bits)
+    per_cluster = model.codebook_kind == CodebookGen.PER_CLUSTER
+
+    def enc(xc, lc):
+        res = _residuals(xc, lc, model.centers, model.rotation_matrix,
+                         model.pq_dim)
+        codes = (_encode_per_cluster(res, lc, model.pq_centers)
+                 if per_cluster else _encode(res, model.pq_centers))
+        return pack_codes(codes, model.pq_bits)
+
+    n = X.shape[0]
+    if n <= _ENCODE_ROWS:
+        return labels, enc(X, labels)
+    parts = []
+    for s in range(0, n, _ENCODE_ROWS):
+        xc, lc = X[s:s + _ENCODE_ROWS], labels[s:s + _ENCODE_ROWS]
+        if xc.shape[0] < _ENCODE_ROWS:
+            # Pad the tail with leading rows: one compiled chunk shape.
+            padn = _ENCODE_ROWS - xc.shape[0]
+            xc = jnp.concatenate([xc, X[:padn]])
+            lc = jnp.concatenate([lc, labels[:padn]])
+        parts.append(enc(xc, lc))
+    return labels, jnp.concatenate(parts)[:n]
 
 
 @traced
