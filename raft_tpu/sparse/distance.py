@@ -381,6 +381,25 @@ def _semiring_combine(metric: DistanceType, p1t, p2):
     return p1t + p2
 
 
+def _semiring_pair(metric: DistanceType, p: float, Xt, xc, xv, Yt, yc,
+                   yv):
+    """(bx, by) unexpanded distances between one staged x block and one
+    staged y block via the two support-gather passes (the shared pair
+    core of :func:`_scan_semiring` and :func:`_scan_knn_semiring`)."""
+    b = Xt.shape[0]
+    # pass 1: f(x, y) over supp(x) — (by, bx·cx) gather.
+    Yg = jnp.take(Yt, xc.reshape(-1), axis=1).reshape(b, b, xc.shape[1])
+    p1 = _semiring_reduce(metric, _ew_core(metric, xv[None], Yg, p))
+    # pass 2: f(0, y) over supp(y) where x == 0.
+    Xg = jnp.take(Xt, yc.reshape(-1), axis=1).reshape(b, b, yc.shape[1])
+    p2 = _semiring_reduce(
+        metric, _ew_core(metric, jnp.zeros((), yv.dtype), yv[None], p),
+        mask=Xg == 0)
+    if metric == DistanceType.BrayCurtis:
+        return _semiring_combine(metric, (p1[0].T, p1[1].T), p2)
+    return _semiring_combine(metric, p1.T, p2)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
 def _scan_semiring(metric: DistanceType, p: float, d: int, b: int,
                    xcols, xvals, ycols, yvals):
@@ -413,22 +432,7 @@ def _scan_semiring(metric: DistanceType, p: float, d: int, b: int,
         def ybody(_, yblk):
             yc, yv = yblk                            # (b, cy)
             Yt = _stage_rows(yc, yv, b, d)
-            # pass 1: f(x, y) over supp(x) — (by, bx·cx) gather.
-            Yg = jnp.take(Yt, xc.reshape(-1), axis=1).reshape(
-                b, b, xc.shape[1])
-            p1 = _semiring_reduce(
-                metric, _ew_core(metric, xv[None], Yg, p))   # (by, bx)
-            # pass 2: f(0, y) over supp(y) where x == 0.
-            Xg = jnp.take(Xt, yc.reshape(-1), axis=1).reshape(
-                b, b, yc.shape[1])
-            p2 = _semiring_reduce(
-                metric, _ew_core(metric, jnp.zeros((), yv.dtype),
-                                 yv[None], p), mask=Xg == 0)  # (bx, by)
-            if metric == DistanceType.BrayCurtis:
-                out = _semiring_combine(
-                    metric, (p1[0].T, p1[1].T), p2)
-            else:
-                out = _semiring_combine(metric, p1.T, p2)
+            out = _semiring_pair(metric, p, Xt, xc, xv, Yt, yc, yv)
             return None, _ew_finalize(metric, out, d, p)
 
         _, out = lax.scan(ybody, None, (ycols, yvals))
@@ -436,6 +440,46 @@ def _scan_semiring(metric: DistanceType, p: float, d: int, b: int,
 
     _, out = lax.scan(xbody, None, (xcols, xvals))
     return out                                       # (nbx, b, nby·b)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3, 4, 5))
+def _scan_knn_semiring(metric: DistanceType, p: float, d: int, b: int,
+                       k: int, n: int, xcols, xvals, ycols, yvals,
+                       bases):
+    """Top-k over y blocks with the support-gather semiring pair core —
+    the kNN companion of :func:`_scan_semiring` (unexpanded metrics at
+    their nnz cost instead of O(d); the select_k-merged carry bounds
+    memory at (b, k + b) like :func:`_scan_knn`)."""
+    select_min = _knn_select_min(metric)
+    worst = jnp.inf if select_min else -jnp.inf
+
+    def xbody(_, xblk):
+        xc, xv = xblk
+        Xt = _stage_rows(xc, xv, b, d)
+
+        def ybody(carry, yblk):
+            bd, bi = carry
+            yc, yv, base = yblk
+            Yt = _stage_rows(yc, yv, b, d)
+            dist = _ew_finalize(
+                metric, _semiring_pair(metric, p, Xt, xc, xv, Yt, yc, yv),
+                d, p)
+            ids = base + jnp.arange(b, dtype=jnp.int32)
+            valid = ids < n
+            dist = jnp.where(valid[None, :], dist, worst)
+            ids_b = jnp.broadcast_to(jnp.where(valid, ids, -1)[None, :],
+                                     dist.shape)
+            cd = jnp.concatenate([bd, dist], axis=1)
+            ci = jnp.concatenate([bi, ids_b], axis=1)
+            return select_k(cd, k, select_min=select_min, indices=ci), None
+
+        init = (jnp.full((b, k), worst, jnp.float32),
+                jnp.full((b, k), -1, jnp.int32))
+        (bd, bi), _ = lax.scan(ybody, init, (ycols, yvals, bases))
+        return None, (bd, bi)
+
+    _, out = lax.scan(xbody, None, (xcols, xvals))
+    return out                                       # ((nbx,b,k), (nbx,b,k))
 
 
 def _block_dist(metric: DistanceType, p: float, d: int, dc: int,
@@ -826,12 +870,54 @@ def knn_blocked(
         return select_k(cd, k, select_min=_knn_select_min(metric),
                         indices=ci)
 
+    p = float(metric_arg)
+    select_min = _knn_select_min(metric)
+
+    # Unexpanded metrics on genuinely sparse rows: the support-gather
+    # semiring kNN (same gate as pairwise_distance's semiring branch).
+    if metric in _EW_METRICS:
+        caprx = next_pow2(max(1, int(np.diff(
+            np.asarray(query.indptr).astype(np.int64)).max(initial=1))))
+        capry = next_pow2(max(1, int(np.diff(
+            np.asarray(idx.indptr).astype(np.int64)).max(initial=1))))
+        if ((caprx + capry) * 8 <= d
+                and 4 * b * b * max(caprx, capry) <= 2 * _EW_CHUNK_BYTES):
+            xcp, xvp, xbc = _row_pad_csr(query, b)
+            ycp, yvp, ybc = _row_pad_csr(idx, b)
+            row_d = [None] * xcp.shape[0]
+            row_i = [None] * xcp.shape[0]
+            for xcap, xids in _nnz_groups(xbc):
+                xs = (xcp[xids, :, :xcap], xvp[xids, :, :xcap])
+                cand_d, cand_i = [], []
+                for ycap, yids in _nnz_groups(ybc):
+                    ys = (ycp[yids, :, :ycap], yvp[yids, :, :ycap])
+                    bases = jnp.asarray((yids.astype(np.int64) * b)
+                                        .astype(np.int32))
+                    bd, bi = _scan_knn_semiring(metric, p, d, b, k, n,
+                                                *xs, *ys, bases)
+                    cand_d.append(bd)
+                    cand_i.append(bi)
+                if len(cand_d) == 1:
+                    bd, bi = cand_d[0], cand_i[0]
+                else:
+                    cd = jnp.concatenate(cand_d, axis=2)
+                    ci = jnp.concatenate(cand_i, axis=2)
+                    g, kk = cd.shape[0], cd.shape[2]
+                    bd, bi = select_k(cd.reshape(g * b, kk), k,
+                                      select_min=select_min,
+                                      indices=ci.reshape(g * b, kk))
+                    bd = bd.reshape(g, b, k)
+                    bi = bi.reshape(g, b, k)
+                for j, xid in enumerate(xids):
+                    row_d[int(xid)] = bd[j]
+                    row_i[int(xid)] = bi[j]
+            return (jnp.concatenate(row_d, axis=0)[:m],
+                    jnp.concatenate(row_i, axis=0)[:m])
+
     xpack, xnnz = _block_pad_csr(query, b)
     ypack, ynnz = _block_pad_csr(idx, b)
     xgroups = _nnz_groups(xnnz)
     ygroups = _nnz_groups(ynnz)
-    p = float(metric_arg)
-    select_min = _knn_select_min(metric)
 
     row_d = [None] * xpack[0].shape[0]
     row_i = [None] * xpack[0].shape[0]
