@@ -254,18 +254,28 @@ class Comms:
 
         expects(self.mesh is not None,
                 "host_sendrecv needs a mesh-bound Comms (build_comms)")
-        x = jnp.asarray(x)
+        x = np.asarray(x)
         expects(x.shape[0] == self.get_size(),
                 "leading axis must equal the comm size (one row per rank)")
         sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(self.axis))
-        xd = jax.device_put(x, sharding)
+        # make_array_from_callback, not device_put: on a multi-process
+        # (jax.distributed) mesh each process can only place its own
+        # addressable shards.
+        xd = jax.make_array_from_callback(x.shape, sharding,
+                                          lambda idx: x[idx])
         fn = jax.jit(_sm(
             lambda v: self.device_sendrecv(v, dest, source),
             mesh=self.mesh,
             in_specs=jax.sharding.PartitionSpec(self.axis),
             out_specs=jax.sharding.PartitionSpec(self.axis)))
-        return np.asarray(jax.device_get(fn(xd)))
+        out = fn(xd)
+        # Rows addressable to THIS process (all rows on a single-process
+        # mesh) — a process cannot read its peers' host buffers, same as
+        # the reference's per-rank recv buffers.
+        shards = sorted(out.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards])
 
 
 def build_comms(mesh: jax.sharding.Mesh, axis: str = "data") -> Comms:

@@ -57,6 +57,21 @@ assert ct.test_collective_reduce(mesh)
 assert ct.test_collective_allgather(mesh)
 assert ct.test_collective_reducescatter(mesh)
 assert ct.test_pointToPoint_simple_send_recv(mesh)
+# device_multicast_sendrecv rides one all_to_all across the DCN
+# process boundary (the cross-process edge set is the point).
+assert ct.test_pointToPoint_device_multicast_sendrecv(mesh)
+# host_sendrecv: each process sees its own received rows (global row =
+# the device's position along the mesh axis, NOT its device id — CPU
+# device ids are per-process-offset).
+from raft_tpu.comms import build_comms
+bc = build_comms(mesh)
+payload = np.arange(2 * nproc, dtype=np.float32)[:, None] * 10.0
+got = bc.host_sendrecv(payload, dest=1, source=0)
+n_all = 2 * nproc
+expect_all = payload[(np.arange(n_all) - 1) % n_all]
+mesh_devs = list(mesh.devices.flat)
+rows = sorted(mesh_devs.index(d) for d in jax.local_devices())
+np.testing.assert_allclose(got, expect_all[rows])
 mesh2d = Mesh(np.array(jax.devices()).reshape(2, -1), ("rows", "cols"))
 assert ct.test_commsplit(mesh2d)
 
