@@ -279,11 +279,14 @@ class Index:
         return int(jnp.sum(self.list_sizes))
 
     def reset_search_cache(self) -> None:
-        """Drop the memoized auto-engine bucket capacity (measured from
-        the first query batch of each shape). The bf16 reconstruction
-        cache is kept — it depends only on the stored codes, not on the
-        query distribution (extend() invalidates both)."""
+        """Drop the memoized query-distribution measurements: the
+        auto-engine bucket capacity and the refine recipe's probe
+        concentration (both measured from the first query batch of each
+        shape). The bf16 reconstruction cache is kept — it depends only
+        on the stored codes, not on the query distribution (extend()
+        invalidates both)."""
         self.__dict__.pop("_auto_cap_cache", None)
+        self.__dict__.pop("_conc_cache", None)
 
     def compressed_scan_operands(self) -> tuple:
         """Cached operands of the compressed-domain Pallas scan
@@ -693,6 +696,34 @@ _RECON_AUTO_BYTES = 4 * 1024 ** 3
 # structureless regimes (BASELINE.md); a SearchParams.min_recall above
 # this makes search() run the exact-refine recipe internally.
 _REFINE_RECALL_CLASS = 0.84
+
+# Probe-concentration threshold below which the refine recipe's bounded
+# per-cell queue is safe (see _probe_concentration).
+_CONC_BOUND_SAFE = 0.5
+
+
+@jax.jit
+def _probe_concentration(Q, centers):
+    """Median over queries of (d₍₁₎−d₍₀₎)/(d₍₁₎+d₍₀₎) of the coarse L2
+    distances: →1 when each query sits INSIDE its best list's cluster
+    (the true candidate pool then concentrates in that one probed list,
+    where a per-probe top-k queue forfeits it), →0 when the two nearest
+    centers are equidistant (structureless queries spread the pool over
+    probes). Measured across the bench regimes, with the refined
+    0.86-class recall the bounded queue achieves there:
+    uniform-1M 0.01 (0.924 ✓) · clustered-loose-1M 0.40 (0.872 ✓) ·
+    tight-blobs-200K 0.56 (0.687 ✗) · SIFT-u8-1M 0.82 (0.814 ✗) —
+    _CONC_BOUND_SAFE = 0.5 sits exactly on the meets/fails boundary.
+    One (q, n_lists) matmul + sort, measured once per (index, batch
+    shape) and memoized like the bucket-capacity heuristic
+    (_pick_engine)."""
+    cn = jnp.sum(centers * centers, axis=1)
+    cd = (jnp.sum(Q * Q, axis=1)[:, None] + cn[None, :]
+          - 2.0 * jnp.matmul(Q, centers.T))
+    cd = jnp.maximum(cd, 0.0)
+    s = jnp.sort(cd, axis=1)
+    d0, d1 = s[:, 0], s[:, 1]
+    return jnp.median((d1 - d0) / jnp.maximum(d1 + d0, 1e-9))
 
 # Row cap for the OPQ alternation's sub-trainset (see build step 3b).
 _OPQ_TRAIN_ROWS = 100_000
@@ -1164,13 +1195,14 @@ def search(
     # neighbors/refine.cuh the same way; here the engine dispatch does
     # it so the caller never spells "refined"). The mapping, measured
     # on the 1M regimes (BASELINE.md round 5):
-    #   (0.84, 0.9] → n_probes≥48, ratio 2, BOUNDED per-cell queue —
-    #       the fast class (~9.4K QPS @ 0.92 uniform); on heavily
-    #       clustered data the bound caps recall near the native class
-    #       (SIFT-u8 0.814) — request > 0.9 there.
-    #   > 0.9      → n_probes≥64, ratio 4, UNBOUNDED queue — the
-    #       robust class (0.997 SIFT-u8 / 0.94-class uniform at ~0.4×
-    #       the fast class's QPS).
+    #   (0.84, 0.9] → n_probes≥48, ratio 2 — structureless batches run
+    #       the fast BOUNDED per-cell queue (~9.4K QPS @ 0.92 uniform);
+    #       concentrated batches are demoted to the pool-deep queue by
+    #       the measured probe concentration (see search_refined — the
+    #       bound would cap recall near native there).
+    #   > 0.9      → n_probes≥64, ratio 4, always pool-deep — the
+    #       robust class (0.997 SIFT-u8 / 0.96 uniform at ~0.25× the
+    #       fast class's QPS).
     if (params.min_recall is not None
             and params.min_recall > _REFINE_RECALL_CLASS):
         if index._source is not None:
@@ -1338,8 +1370,21 @@ def search_refined(
     # re-rank follows), so with ``bound_queue`` each (query, probe)
     # contributes its top-k only — the in-kernel queue cost stays that
     # of k, not ratio·k (measured 6.1K → ~10K QPS at the 1M uniform
-    # config; the clustered-regime trade-off is documented on
-    # _compressed_search and driven by the min_recall mapping).
+    # config). The bound is only SAFE on structureless query loads: the
+    # measured probe concentration (memoized per batch shape) demotes
+    # concentrated batches to the pool-deep queue, where the bound would
+    # cap recall near the native class (see _probe_concentration /
+    # _compressed_search). Under an outer jit the measurement is
+    # impossible — correctness wins and the queue stays pool-deep.
+    if bound_queue:
+        if isinstance(Q, jax.core.Tracer):
+            bound_queue = False
+        else:
+            cache = index.__dict__.setdefault("_conc_cache", {})
+            key = Q.shape
+            if key not in cache:
+                cache[key] = float(_probe_concentration(Q, index.centers))
+            bound_queue = cache[key] < _CONC_BOUND_SAFE
     if (pool <= n_probes * k and Q.ndim == 2 and Q.shape[1] == index.dim
             and _compressed_eligible(params, index, n_probes, pool,
                                      Q.shape[0], default_dtypes)):

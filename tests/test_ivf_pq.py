@@ -94,6 +94,34 @@ class TestIvfPq:
             ivf_pq.SearchParams(n_probes=48), index, None, q, 10)
         assert _recall(np.asarray(i2), truth) > 0.86
 
+    def test_min_recall_concentrated_batch_demotes_bound(self, dataset):
+        """On a concentrated query batch (tight clusters) the fast
+        class's bounded per-cell queue must demote to pool-deep — the
+        bound would cap recall near the native class (the regime gap
+        verify caught in round 5)."""
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors.ivf_pq import (_CONC_BOUND_SAFE,
+                                               _probe_concentration)
+
+        rng = np.random.default_rng(9)
+        centers = rng.normal(size=(32, 16)).astype(np.float32) * 60
+        db = (centers[rng.integers(0, 32, 6000)]
+              + rng.normal(size=(6000, 16)).astype(np.float32))
+        q = (db[:60] + 0.3 * rng.normal(size=(60, 16))).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=32, pq_dim=8,
+                                    kmeans_n_iters=8)
+        index = ivf_pq.build(params, db.astype(np.float32))
+        conc = float(_probe_concentration(jnp.asarray(q), index.centers))
+        assert conc > _CONC_BOUND_SAFE, conc   # the fixture IS clustered
+        sp = ivf_pq.SearchParams(n_probes=16, min_recall=0.86)
+        d, i = ivf_pq.search(sp, index, q, 10)
+        assert index._conc_cache, "concentration must be memoized"
+        dn = ((q[:, None, :] - db[None]) ** 2).sum(-1)
+        truth = np.argsort(dn, axis=1)[:, :10]
+        rec = _recall(np.asarray(i), truth)
+        assert rec > 0.8, rec
+
     def test_min_recall_without_source_warns_not_crashes(self, dataset,
                                                          tmp_path):
         """A loaded index retains no dataset: the recall request degrades
