@@ -290,16 +290,16 @@ class Index:
 
     def compressed_scan_operands(self) -> tuple:
         """Cached operands of the compressed-domain Pallas scan
-        (ops/pq_scan.py): ``(codesT, abs_lo, abs_hi, invalid)`` — the
+        (ops/pq_scan.py): ``(codesT, lo, hi, invalid, crot_p)`` — the
         transposed packed codes (= codes size, pre-padded to the
         kernel's group width so no per-search copy of the index is
-        made), the per-list absolute codeword tables
-        (n_lists·rot_dim·max(B,128) f32, ~4× the codes at the default
-        config; far below the decompressed index) and the padded
-        slot-validity mask. Rebuilt lazily after extend();
-        PER_SUBSPACE + pq_bits∈{4,8} only."""
+        made), the SHARED codeword tables (rot_dim·max(B,128) f32,
+        ~130 KB — the per-list center component moved to the query side,
+        see ops/pq_scan.book_tables), the padded slot-validity mask,
+        and the permuted rotated centers the query shift needs. Rebuilt
+        lazily after extend(); PER_SUBSPACE + pq_bits∈{4,8} only."""
         if self._scan_ops is None:
-            from raft_tpu.ops.pq_scan import (_SC, absolute_book_tables,
+            from raft_tpu.ops.pq_scan import (_SC, book_tables,
                                               permute_subspaces)
             cap = self.pq_codes.shape[1]
             capp = ceildiv(cap, _SC) * _SC
@@ -312,9 +312,8 @@ class Index:
                                      precision=lax.Precision.HIGHEST)
             crot_p = permute_subspaces(centers_rot, self.pq_dim,
                                        self.pq_bits)
-            abs_lo, abs_hi = absolute_book_tables(self.pq_centers, crot_p,
-                                                  self.pq_bits)
-            ops = (codesT, abs_lo, abs_hi, invalid)
+            lo, hi = book_tables(self.pq_centers, self.pq_bits)
+            ops = (codesT, lo, hi, invalid, crot_p)
             if isinstance(codesT, jax.core.Tracer):
                 return ops
             object.__setattr__(self, "_scan_ops", ops)
@@ -549,10 +548,10 @@ def _compressed_supported(index: Index) -> bool:
 
 
 @functools.partial(jax.jit,
-                   static_argnums=(8, 9, 10, 11, 12, 13, 14, 15))
+                   static_argnums=(9, 10, 11, 12, 13, 14, 15, 16))
 def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
-                       indices, n_probes: int, k: int, is_ip: bool,
-                       J: int, bits: int, qrows: int,
+                       indices, crot_p, n_probes: int, k: int,
+                       is_ip: bool, J: int, bits: int, qrows: int,
                        interpret: bool = False, cell_k: int = 0):
     """The compressed-domain tier as ONE jitted program — coarse probe,
     rotation, cells inversion, Pallas scan, routing and the final merge.
@@ -588,11 +587,27 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
         probe_ids, n_lists, qrows)
     rotq_p = permute_subspaces(rotq, J, bits)
     Qc = rotq_p[jnp.maximum(bucket, 0)]            # (max_cells, qrows, d)
+    safe_cl = jnp.maximum(cell_list, 0)
+    if not is_ip:
+        # Residual-scale operands (book_tables): shift each cell's query
+        # rows by its list's rotated center — ‖(q−c) − cw‖² ≡ the
+        # absolute ADC distance, scored at residual magnitude where bf16
+        # rounding is relative to the signal, not the embedding offset.
+        Qc = Qc - crot_p[safe_cl][:, None, :]
 
     bd_, bi_ = pq_fused_scan(cell_list, Qc, codesT, abs_lo, abs_hi,
                              invalid, cell_k, J, bits, is_ip, interpret)
-    gi = indices[jnp.maximum(cell_list, 0)[:, None, None],
-                 jnp.maximum(bi_, 0)]
+    if is_ip:
+        # score = q·(c + cw) = q·c + q·cw; the kernel reports −(q·cw).
+        # q·c is constant within a cell, so adding it after the in-cell
+        # selection preserves the selected set; the cross-cell merge
+        # then ranks by the corrected totals. Computed in f32 HIGHEST
+        # (permutation-invariant dot: rotq_p·crot_p ≡ rotq·crot).
+        qc = jnp.matmul(rotq_p, crot_p.T,
+                        precision=lax.Precision.HIGHEST)  # (q, n_lists)
+        qc_pair = qc[jnp.maximum(bucket, 0), safe_cl[:, None]]
+        bd_ = bd_ - qc_pair[:, :, None]
+    gi = indices[safe_cl[:, None, None], jnp.maximum(bi_, 0)]
     gi = jnp.where(bi_ < 0, -1, gi)
     # The kernel reports min-selection order for both metrics (negated
     # inner products); undo the negation after the final merge.
@@ -721,8 +736,8 @@ def _probe_concentration(Q, centers):
     cd = (jnp.sum(Q * Q, axis=1)[:, None] + cn[None, :]
           - 2.0 * jnp.matmul(Q, centers.T))
     cd = jnp.maximum(cd, 0.0)
-    s = jnp.sort(cd, axis=1)
-    d0, d1 = s[:, 0], s[:, 1]
+    top2, _ = jax.lax.top_k(-cd, 2)          # only the 2 nearest needed
+    d0, d1 = -top2[:, 0], -top2[:, 1]
     return jnp.median((d1 - d0) / jnp.maximum(d1 + d0, 1e-9))
 
 # Row cap for the OPQ alternation's sub-trainset (see build step 3b).
@@ -1196,7 +1211,7 @@ def search(
     # it so the caller never spells "refined"). The mapping, measured
     # on the 1M regimes (BASELINE.md round 5):
     #   (0.84, 0.9] → n_probes≥48, ratio 2 — structureless batches run
-    #       the fast BOUNDED per-cell queue (~9.4K QPS @ 0.92 uniform);
+    #       the fast BOUNDED per-cell queue (9.4-9.8K QPS @ 0.924 uniform, BENCH_r05);
     #       concentrated batches are demoted to the pool-deep queue by
     #       the measured probe concentration (see search_refined — the
     #       bound would cap recall near native there).
@@ -1214,7 +1229,7 @@ def search(
                 n_probes=max(params.n_probes, 64 if robust else 48))
             return search_refined(sp, index, index._source, queries, k,
                                   refine_ratio=ratio, handle=handle,
-                                  bound_queue=not robust)
+                                  bound_queue=False if robust else None)
         from raft_tpu.core.logger import logger
         logger.warning(
             "min_recall=%.2f requested but the index retains no source "
@@ -1244,10 +1259,11 @@ def search(
     # tier below instead.
     if _compressed_eligible(params, index, n_probes, k, Q.shape[0],
                             default_dtypes):
-        codesT, abs_lo, abs_hi, invalid = index.compressed_scan_operands()
+        codesT, abs_lo, abs_hi, invalid, crot_p = \
+            index.compressed_scan_operands()
         best_d, best_i = _compressed_search(
             Q, index.centers, index.rotation_matrix, codesT, abs_lo,
-            abs_hi, invalid, index.indices, n_probes, k, is_ip,
+            abs_hi, invalid, index.indices, crot_p, n_probes, k, is_ip,
             index.pq_dim, index.pq_bits,
             min(_CELL_QROWS, max(8, Q.shape[0])), interpret)
         if index.metric == DistanceType.L2SqrtExpanded:
@@ -1316,7 +1332,8 @@ def search(
 @traced
 def search_refined(
     params: SearchParams, index: Index, dataset, queries, k: int,
-    refine_ratio: int = 2, handle=None, bound_queue: bool = True,
+    refine_ratio: int = 2, handle=None,
+    bound_queue: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Over-retrieve ``refine_ratio·k`` PQ candidates and exact-refine to
     k against ``dataset`` — the reference's standard recipe for lifting
@@ -1332,11 +1349,17 @@ def search_refined(
     Callers can request this recipe implicitly via
     ``SearchParams.min_recall`` instead.
 
-    ``bound_queue`` (compressed fast path only): True keeps each
-    (query, probe) cell's in-kernel queue at k — ~1.7× the QPS, but on
-    heavily clustered data the best list can hold the whole true pool
-    and the bound caps recall near the native class (see
-    _compressed_search); False scans every cell pool-deep.
+    ``bound_queue`` (compressed fast path only): ``None`` (default)
+    keeps each (query, probe) cell's in-kernel queue at k — ~1.7× the
+    QPS — on query batches the measured probe concentration deems safe,
+    and demotes concentrated batches to the pool-deep queue (on
+    clustered data the best list can hold the whole true pool and the
+    bound caps recall near the native class; see _probe_concentration /
+    _compressed_search). ``True`` forces the bounded queue (no
+    measurement — benchmarking/pinning), ``False`` forces pool-deep.
+    The auto mode measures L2 coarse geometry only: InnerProduct
+    indexes probe by IP, where the statistic is uncalibrated, so IP
+    always runs pool-deep unless forced.
     """
     from raft_tpu.neighbors.refine import refine
 
@@ -1367,32 +1390,38 @@ def search_refined(
     k = min(k, max(index.capacity, 1))
     pool = min(refine_ratio * k, max(index.capacity, 1))
     # Compressed fast path: the refine pool is a candidate set (exact
-    # re-rank follows), so with ``bound_queue`` each (query, probe)
+    # re-rank follows), so with the bounded queue each (query, probe)
     # contributes its top-k only — the in-kernel queue cost stays that
     # of k, not ratio·k (measured 6.1K → ~10K QPS at the 1M uniform
-    # config). The bound is only SAFE on structureless query loads: the
-    # measured probe concentration (memoized per batch shape) demotes
-    # concentrated batches to the pool-deep queue, where the bound would
-    # cap recall near the native class (see _probe_concentration /
-    # _compressed_search). Under an outer jit the measurement is
-    # impossible — correctness wins and the queue stays pool-deep.
-    if bound_queue:
-        if isinstance(Q, jax.core.Tracer):
-            bound_queue = False
-        else:
-            cache = index.__dict__.setdefault("_conc_cache", {})
-            key = Q.shape
-            if key not in cache:
-                cache[key] = float(_probe_concentration(Q, index.centers))
-            bound_queue = cache[key] < _CONC_BOUND_SAFE
+    # config). The bound is only SAFE on structureless query loads:
+    # bound_queue=None measures the probe concentration (memoized per
+    # batch shape, inside the eligibility gate so ineligible configs
+    # never pay the matmul+sync) and demotes concentrated batches to
+    # the pool-deep queue, where the bound would cap recall near the
+    # native class (see _probe_concentration / _compressed_search).
+    # Under an outer jit, or for IP metric (uncalibrated geometry),
+    # auto resolves pool-deep — correctness first.
     if (pool <= n_probes * k and Q.ndim == 2 and Q.shape[1] == index.dim
             and _compressed_eligible(params, index, n_probes, pool,
                                      Q.shape[0], default_dtypes)):
-        codesT, abs_lo, abs_hi, invalid = index.compressed_scan_operands()
+        if bound_queue is None:
+            if is_ip or isinstance(Q, jax.core.Tracer):
+                bound_queue = False
+            elif index.n_lists < 2:
+                bound_queue = False  # the single list holds every pool
+            else:
+                cache = index.__dict__.setdefault("_conc_cache", {})
+                key = Q.shape
+                if key not in cache:
+                    cache[key] = float(
+                        _probe_concentration(Q, index.centers))
+                bound_queue = cache[key] < _CONC_BOUND_SAFE
+        codesT, abs_lo, abs_hi, invalid, crot_p = \
+            index.compressed_scan_operands()
         _, i = _compressed_search(
             Q, index.centers, index.rotation_matrix, codesT, abs_lo,
-            abs_hi, invalid, index.indices, n_probes, pool, is_ip,
-            index.pq_dim, index.pq_bits,
+            abs_hi, invalid, index.indices, crot_p, n_probes, pool,
+            is_ip, index.pq_dim, index.pq_bits,
             min(_CELL_QROWS, max(8, Q.shape[0])),
             jax.default_backend() != "tpu",
             min(k, pool) if bound_queue else 0)

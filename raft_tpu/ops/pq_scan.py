@@ -16,15 +16,20 @@ TPU-native re-design (bucketed layout, one grid cell per list):
   codebook directly (pq_bits=4 splits nibbles into two row blocks in a
   statically permuted subspace order; the query/codebook operands are
   permuted outside to match — L2/IP are permutation-invariant);
-* the codebook rides as a per-list **absolute table**
-  ``absT[l, j·L + s, b] = books[j, b, s] + centers_rot[l, j·L + s]`` —
-  the VMEM-resident LUT role of the reference's smem LUT. Decoding a
-  chunk is then two ``tpu.dynamic_gather`` ops (B=256 splits into two
-  128-lane halves) producing the *transposed* absolute reconstruction
-  ``cwT (rot_dim, 128)`` — no one-hot, no B× MAC inflation (a prior
-  block-diagonal one-hot matmul formulation measured 2.2K QPS at 1M
-  against this design's ~10× — the MXU is cycle-bound at M=N=128, while
-  gathers run ~0.08 µs per (128,128) tile);
+* the codebook rides as ONE shared **codeword table**
+  ``bt[j·L + s, b] = books[j, b, s]`` (VMEM-resident across the whole
+  grid — the LUT role of the reference's smem LUT); the per-list
+  rotated-center component is subtracted from the QUERY side per cell
+  by the caller, so the bf16 MXU scores RESIDUAL-scale operands (the
+  round-4 absolute-reconstruction tables made scoring error relative
+  to the absolute embedding — an offset-dominated geometry measured
+  recall 0.115 vs 0.908; see book_tables). Decoding a chunk is two
+  ``tpu.dynamic_gather`` ops (B=256 splits into two 128-lane halves)
+  producing the *transposed* codeword block ``cwT (rot_dim, 128)`` —
+  no one-hot, no B× MAC inflation (a prior block-diagonal one-hot
+  matmul formulation measured 2.2K QPS at 1M against this design's
+  ~10× — the MXU is cycle-bound at M=N=128, while gathers run
+  ~0.08 µs per (128,128) tile);
 * scoring is a (bq, rot_dim)×(rot_dim, 128) MXU matmul per chunk plus the
   L2 norm epilogue (column norms of cwT are a cheap sublane reduction);
 * the in-VMEM k-pass queue (ops/fused_knn._kpass_select) folds each
@@ -32,9 +37,8 @@ TPU-native re-design (bucketed layout, one grid cell per list):
   maps results back to queries.
 
 Memory beyond the packed codes: the transposed code copy (= codes size)
-and the absolute tables (n_lists·rot_dim·B f32 — 134 MB at the 1M/128-dim
-shape, ~4× the codes, ~4× less than the decompressed bf16 index), both
-cached on the Index.
+and the shared codeword table (rot_dim·B f32 — ~130 KB), cached on the
+Index.
 """
 
 from __future__ import annotations
@@ -80,25 +84,35 @@ def permute_subspaces(x: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
     return x3[..., jnp.asarray(perm, jnp.int32), :].reshape(x.shape)
 
 
-def absolute_book_tables(pq_centers: jax.Array, centers_rot: jax.Array,
-                         pq_bits: int) -> Tuple[jax.Array, jax.Array]:
-    """Per-list absolute codeword tables for the gather decode:
-    ``absT[l, j'·L + s, b] = books[perm[j'], b, s] + centers_rot_perm[l,
-    j'·L + s]`` split into two 128-lane halves (lo, hi) over the code
-    axis (B ≤ 128 pads lo and leaves hi unused). centers_rot must
-    already be permuted (permute_subspaces)."""
+def book_tables(pq_centers: jax.Array,
+                pq_bits: int) -> Tuple[jax.Array, jax.Array]:
+    """Codeword tables for the gather decode, SHARED across lists:
+    ``bt[0, j'·L + s, b] = books[perm[j'], b, s]`` split into two
+    128-lane halves (lo, hi) over the code axis (B ≤ 128 pads lo and
+    leaves hi unused).
+
+    Round-5 redesign: the tables carry the CODEBOOK only — the per-list
+    rotated-center component is subtracted from the QUERY side per cell
+    instead (ivf_pq._compressed_search), so the kernel's bf16 matmul
+    sees residual-scale operands. The round-4 absolute tables
+    (books + centers_rot, one table per list) made the scoring error
+    relative to the absolute embedding magnitude: an offset-dominated
+    geometry (queries inside tight far-from-origin clusters) measured
+    recall 0.115 vs the LUT scan's 0.908 because neighbor gaps sat
+    below bf16 resolution at the offset (BASELINE.md round 5). Sharing
+    one table also cuts the scan operands from n_lists·rot·128 f32
+    (134 MB at the 1M default config) to rot·256 f32 (~130 KB)."""
     J, B, L = pq_centers.shape
     perm = jnp.asarray(subspace_perm(J, pq_bits), jnp.int32)
     # (J, B, L) -> rows (j, s) in j-major order, columns b.
     bt = pq_centers[perm].transpose(0, 2, 1).reshape(J * L, B)
-    absT = bt[None, :, :] + centers_rot[:, :, None]  # (n_lists, rot, B)
     if B <= _LANES:
         if B < _LANES:
-            absT = jnp.pad(absT, ((0, 0), (0, 0), (0, _LANES - B)))
+            bt = jnp.pad(bt, ((0, 0), (0, _LANES - B)))
         # hi is never read for B <= 128 — a 1-row dummy keeps the kernel
-        # operand list fixed without DMAing a duplicate table per list.
-        return absT, absT[:, :1, :]
-    return absT[:, :, :_LANES], absT[:, :, _LANES:]
+        # operand list fixed.
+        return bt[None], bt[None, :1, :]
+    return bt[None, :, :_LANES], bt[None, :, _LANES:]
 
 
 def _pq_scan_kernel(cell_ref, rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
@@ -196,14 +210,18 @@ def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
     unused; see ivf_flat._invert_probe_map_cells), prefetched so the
     kernel's block index maps can stream each cell's list operands.
     rotq_cells: (max_cells, qrows, rot_dim) f32 query rows per cell,
-    already in the kernel's permuted subspace order (permute_subspaces).
-    codesT: (n_lists, nbytes, cap) u8 transposed packed rows. abs_lo /
-    abs_hi: (n_lists, rot_dim, 128) f32 absolute codeword tables
-    (absolute_book_tables). invalid: (n_lists, cap) bool. Returns
-    (distances (max_cells, qrows, k), local slot ids). L2 metrics report
-    squared distances of the bf16-scored reconstruction (like the
-    recon-cache engine); is_ip reports negated inner products
-    (min-select order).
+    already in the kernel's permuted subspace order (permute_subspaces)
+    and, for L2, already SHIFTED by the cell's rotated list center (the
+    residual-scale operand convention of book_tables — the caller owns
+    the shift, ivf_pq._compressed_search). codesT: (n_lists, nbytes,
+    cap) u8 transposed packed rows. abs_lo / abs_hi: (1, rot_dim, 128)
+    f32 shared codeword tables (book_tables). invalid: (n_lists, cap)
+    bool. Returns (distances (max_cells, qrows, k), local slot ids).
+    L2 metrics report squared RESIDUAL distances ‖(q−c) − codeword‖²
+    (≡ the absolute ADC distance, computed at residual scale); is_ip
+    reports negated codeword inner products — the caller adds the
+    per-(query, list) q·c term after (constant within a cell, so
+    in-cell selection order is unaffected).
     """
     max_cells, qrows, rot_dim = rotq_cells.shape
     nbytes, cap = codesT.shape[1], codesT.shape[2]
@@ -234,11 +252,14 @@ def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, nbytes, capp), by_list,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, rot_dim, _LANES), by_list,
+            # Codeword tables are SHARED across lists (constant block —
+            # stays VMEM-resident across the whole grid).
+            pl.BlockSpec((1, rot_dim, _LANES), lambda b, cl: (0, 0, 0),
                          memory_space=pltpu.VMEM),
             # hi half of the code axis — a 1-row dummy when B <= 128
             # (the kernel statically never reads it).
-            pl.BlockSpec((1, abs_hi.shape[1], _LANES), by_list,
+            pl.BlockSpec((1, abs_hi.shape[1], _LANES),
+                         lambda b, cl: (0, 0, 0),
                          memory_space=pltpu.VMEM),
             # A middle unit axis keeps the mask block's trailing two dims
             # (1, capp) legal for the mosaic lowering (see fused_knn).

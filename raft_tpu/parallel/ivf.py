@@ -261,13 +261,13 @@ def sharded_ivf_pq_build(
 def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
     """Per-shard operands of the compressed-domain Pallas scan, cached on
     the sharded index (the multi-device analog of
-    ``Index.compressed_scan_operands``): ``(codesT, invalid, abs_lo,
-    abs_hi)`` — transposed packed codes and slot masks sharded over
-    ``mesh[axis]``; the absolute codeword tables are computed from the
-    REPLICATED model (centers/rotation/books do not depend on which rows
-    a shard holds), so they replicate like the centers."""
+    ``Index.compressed_scan_operands``): ``(codesT, invalid, lo, hi,
+    crot_p)`` — transposed packed codes and slot masks sharded over
+    ``mesh[axis]``; the shared codeword tables and the permuted rotated
+    centers come from the REPLICATED model (they do not depend on which
+    rows a shard holds), so they replicate like the centers."""
     if index._scan_cache is None:
-        from raft_tpu.ops.pq_scan import (_SC, absolute_book_tables,
+        from raft_tpu.ops.pq_scan import (_SC, book_tables,
                                           permute_subspaces)
         sharding = NamedSharding(mesh, P(index.axis))
         cap = index.pq_codes.shape[2]
@@ -283,9 +283,8 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
         centers_rot = jnp.matmul(index.centers, index.rotation_matrix.T,
                                  precision=lax.Precision.HIGHEST)
         crot_p = permute_subspaces(centers_rot, index.pq_dim, index.pq_bits)
-        abs_lo, abs_hi = absolute_book_tables(index.pq_centers, crot_p,
-                                              index.pq_bits)
-        index._scan_cache = (codesT, invalid, abs_lo, abs_hi)
+        lo, hi = book_tables(index.pq_centers, index.pq_bits)
+        index._scan_cache = (codesT, invalid, lo, hi, crot_p)
     return index._scan_cache
 
 
@@ -294,8 +293,8 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
                               "pq_dim", "pq_bits", "sqrt", "qrows",
                               "interpret"))
 def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
-                               abs_lo, abs_hi, Q, *, mesh, axis, k,
-                               n_probes, is_ip, pq_dim, pq_bits, sqrt,
+                               abs_lo, abs_hi, crot_p, Q, *, mesh, axis,
+                               k, n_probes, is_ip, pq_dim, pq_bits, sqrt,
                                qrows, interpret):
     """Sharded compressed-domain search: each shard runs the PRODUCTION
     single-chip pipeline (``ivf_pq._compressed_search`` — packed query
@@ -306,12 +305,14 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
     LUT scan tier)."""
     n_dev = mesh.shape[axis]
 
-    def body(codesT_l, inv_l, idx_l, centers_r, rot_r, lo_r, hi_r, q):
+    def body(codesT_l, inv_l, idx_l, centers_r, rot_r, lo_r, hi_r,
+             crot_r, q):
         codesT_l, inv_l, idx_l = codesT_l[0], inv_l[0], idx_l[0]
         kk = min(k, idx_l.shape[0] * idx_l.shape[1])
         d, i = _pq._compressed_search(
             q, centers_r, rot_r, codesT_l, lo_r, hi_r, inv_l, idx_l,
-            n_probes, kk, is_ip, pq_dim, pq_bits, qrows, interpret)
+            crot_r, n_probes, kk, is_ip, pq_dim, pq_bits, qrows,
+            interpret)
         all_d = lax.all_gather(d, axis, axis=1, tiled=True)
         all_i = lax.all_gather(i, axis, axis=1, tiled=True)
         keys = all_d if is_ip else -all_d
@@ -323,9 +324,11 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
 
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P()),
+        in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P(),
+                  P()),
         out_specs=(P(), P()))
-    return fn(codesT, invalid, indices, centers, rot, abs_lo, abs_hi, Q)
+    return fn(codesT, invalid, indices, centers, rot, abs_lo, abs_hi,
+              crot_p, Q)
 
 
 @functools.partial(
@@ -397,10 +400,11 @@ def sharded_ivf_pq_search(
         k, index.pq_codes.shape[2], index.pq_codes.shape[3],
         index.rot_dim, Q.shape[0], n_probes, n_lists)
     if use_compressed:
-        codesT, invalid, abs_lo, abs_hi = _sharded_scan_operands(mesh, index)
+        codesT, invalid, abs_lo, abs_hi, crot_p = \
+            _sharded_scan_operands(mesh, index)
         return _sharded_pq_compressed_jit(
             codesT, invalid, index.indices, index.centers,
-            index.rotation_matrix, abs_lo, abs_hi, Q,
+            index.rotation_matrix, abs_lo, abs_hi, crot_p, Q,
             mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
             is_ip=is_ip, pq_dim=index.pq_dim, pq_bits=index.pq_bits,
             sqrt=sqrt,

@@ -114,7 +114,10 @@ class TestIvfPq:
         index = ivf_pq.build(params, db.astype(np.float32))
         conc = float(_probe_concentration(jnp.asarray(q), index.centers))
         assert conc > _CONC_BOUND_SAFE, conc   # the fixture IS clustered
-        sp = ivf_pq.SearchParams(n_probes=16, min_recall=0.86)
+        # engine="bucketed" forces the compressed path on CPU (interpret
+        # mode) — the measurement only runs inside the eligible branch.
+        sp = ivf_pq.SearchParams(n_probes=16, min_recall=0.86,
+                                 engine="bucketed")
         d, i = ivf_pq.search(sp, index, q, 10)
         assert index._conc_cache, "concentration must be memoized"
         dn = ((q[:, None, :] - db[None]) ** 2).sum(-1)

@@ -170,8 +170,8 @@ class TestPqFusedScanProperties:
     @pytest.mark.parametrize("pq_bits", [4, 8])
     def test_random_shapes_vs_oracle(self, seed, pq_bits):
         from raft_tpu.neighbors.ivf_pq import pack_codes
-        from raft_tpu.ops.pq_scan import (absolute_book_tables,
-                                          permute_subspaces, pq_fused_scan)
+        from raft_tpu.ops.pq_scan import (book_tables, permute_subspaces,
+                                          pq_fused_scan)
 
         rng = np.random.default_rng(400 + seed)
         n_lists = int(rng.integers(2, 6))
@@ -195,10 +195,12 @@ class TestPqFusedScanProperties:
         cell_list = rng.integers(0, n_lists, size=max_cells).astype(
             np.int32)
 
-        crot_p = permute_subspaces(jnp.asarray(centers_rot), J, pq_bits)
-        lo, hi = absolute_book_tables(jnp.asarray(books), crot_p, pq_bits)
-        rotq_p = np.asarray(permute_subspaces(jnp.asarray(rotq), J,
-                                              pq_bits))
+        lo, hi = book_tables(jnp.asarray(books), pq_bits)
+        # The caller-side shift (residual-scale operands): each cell's
+        # query rows minus its list's rotated center.
+        rotq_shifted = rotq - centers_rot[cell_list][:, None, :]
+        rotq_p = np.asarray(permute_subspaces(jnp.asarray(rotq_shifted),
+                                              J, pq_bits))
         bd, bi = pq_fused_scan(
             jnp.asarray(cell_list), jnp.asarray(rotq_p),
             jnp.asarray(codesT), lo, hi, jnp.asarray(invalid),
@@ -237,32 +239,30 @@ class TestPqFusedScanProperties:
                             (c, r, int(x))
 
     def test_ip_polarity(self):
-        """is_ip=True must report NEGATED inner products (min-select
-        order) of the reconstruction — the polarity contract the cells
-        routing depends on."""
+        """is_ip=True must report NEGATED codeword inner products
+        (min-select order; the per-list q·c term is the caller's
+        post-add) — the polarity contract the cells routing depends
+        on."""
         from raft_tpu.neighbors.ivf_pq import pack_codes
-        from raft_tpu.ops.pq_scan import (absolute_book_tables,
-                                          permute_subspaces, pq_fused_scan)
+        from raft_tpu.ops.pq_scan import book_tables, pq_fused_scan
 
         rng = np.random.default_rng(77)
         n_lists, J, L, cap, qrows, k = 2, 2, 2, 32, 4, 5
         rot, B = J * L, 256
         books = rng.normal(size=(J, B, L)).astype(np.float32)
-        centers_rot = rng.normal(size=(n_lists, rot)).astype(np.float32)
         codes = rng.integers(0, B, size=(n_lists, cap, J))
         codesT = np.swapaxes(np.asarray(pack_codes(jnp.asarray(codes), 8)),
                              1, 2)
         invalid = np.zeros((n_lists, cap), bool)
         rotq = rng.normal(size=(1, qrows, rot)).astype(np.float32)
-        lo, hi = absolute_book_tables(jnp.asarray(books),
-                                      jnp.asarray(centers_rot), 8)
+        lo, hi = book_tables(jnp.asarray(books), 8)
         bd, bi = pq_fused_scan(
             jnp.asarray([1], dtype=jnp.int32), jnp.asarray(rotq),
             jnp.asarray(codesT), lo, hi, jnp.asarray(invalid),
             k, J, 8, True, interpret=True)
-        recon = (books[np.arange(J)[None, None, :], codes]
-                 .reshape(n_lists, cap, rot) + centers_rot[:, None, :])
-        scores = rotq[0] @ recon[1].T
+        cw = (books[np.arange(J)[None, None, :], codes]
+              .reshape(n_lists, cap, rot))
+        scores = rotq[0] @ cw[1].T
         want = -np.sort(-scores, axis=1)[:, :k]     # best (largest) first
         np.testing.assert_allclose(-np.asarray(bd)[0], want, rtol=5e-2,
                                    atol=5e-2)

@@ -10,7 +10,7 @@ import pytest
 import jax.numpy as jnp
 
 from raft_tpu.neighbors import brute_force, ivf_pq
-from raft_tpu.ops.pq_scan import (absolute_book_tables, permute_subspaces,
+from raft_tpu.ops.pq_scan import (book_tables, permute_subspaces,
                                   subspace_perm)
 
 
@@ -19,32 +19,26 @@ def _recall(a, b, k):
                     / k for r in range(a.shape[0])])
 
 
-class TestAbsoluteTables:
-    def test_absolute_table_rows(self, rng):
-        """absT[l, j·L + s, b] must equal books[perm[j], b, s] +
-        centers_rot[l, j·L + s] — the gather decode then yields the
-        absolute reconstruction column directly."""
-        J, B, L, nl = 4, 256, 2, 3
+class TestBookTables:
+    def test_table_rows(self, rng):
+        """bt[0, j·L + s, b] must equal books[perm[j], b, s] — the gather
+        decode then yields the codeword column directly (the per-list
+        center lives on the query side since round 5)."""
+        J, B, L = 4, 256, 2
         books = rng.normal(size=(J, B, L)).astype(np.float32)
-        crot = rng.normal(size=(nl, J * L)).astype(np.float32)
         lo, hi = (np.asarray(t) for t in
-                  absolute_book_tables(jnp.asarray(books),
-                                       jnp.asarray(crot), 8))
-        full = np.concatenate([lo, hi], axis=2)    # (nl, J*L, 256)
-        for li in range(nl):
-            for j in range(J):
-                for s in range(L):
-                    np.testing.assert_allclose(
-                        full[li, j * L + s],
-                        books[j, :, s] + crot[li, j * L + s], rtol=1e-6)
+                  book_tables(jnp.asarray(books), 8))
+        full = np.concatenate([lo, hi], axis=2)    # (1, J*L, 256)
+        for j in range(J):
+            for s in range(L):
+                np.testing.assert_allclose(
+                    full[0, j * L + s], books[j, :, s], rtol=1e-6)
 
     def test_small_b_pads_lanes(self, rng):
         J, B, L = 4, 16, 2
         books = rng.normal(size=(J, B, L)).astype(np.float32)
-        crot = rng.normal(size=(2, J * L)).astype(np.float32)
-        lo, hi = absolute_book_tables(jnp.asarray(books),
-                                      jnp.asarray(crot), 4)
-        assert lo.shape == (2, J * L, 128)
+        lo, hi = book_tables(jnp.asarray(books), 4)
+        assert lo.shape == (1, J * L, 128)
 
     def test_permute_roundtrip_consistency(self, rng):
         """permute_subspaces reorders (J, L) blocks by the same perm the
