@@ -1,0 +1,178 @@
+"""Randomized-shape parity against scikit-learn — the reference's own
+Python test style (pylibraft test_kmeans.py / cpp stats tests compare
+against sklearn-equivalent host references). Every metric runs over
+several seeded random shapes, not one fixture, so reduction order,
+padding and masking paths are exercised across the envelope.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import stats
+
+
+def _labels(rng, n, k):
+    return rng.integers(0, k, size=n).astype(np.int32)
+
+
+class TestClusterMetricParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_adjusted_rand(self, seed):
+        from sklearn.metrics import adjusted_rand_score
+
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(20, 800))
+        k = int(rng.integers(2, 12))
+        a, b = _labels(rng, n, k), _labels(rng, n, k)
+        got = float(stats.adjusted_rand_index(a, b))
+        want = adjusted_rand_score(a, b)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_rand_index(self, seed):
+        from sklearn.metrics import rand_score
+
+        rng = np.random.default_rng(10 + seed)
+        n = int(rng.integers(20, 500))
+        a, b = _labels(rng, n, 5), _labels(rng, n, 7)
+        np.testing.assert_allclose(float(stats.rand_index(a, b)),
+                                   rand_score(a, b), rtol=1e-5)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_mutual_info(self, seed):
+        from sklearn.metrics import mutual_info_score
+
+        rng = np.random.default_rng(20 + seed)
+        n = int(rng.integers(30, 600))
+        a, b = _labels(rng, n, 6), _labels(rng, n, 4)
+        np.testing.assert_allclose(float(stats.mutual_info_score(a, b)),
+                                   mutual_info_score(a, b),
+                                   rtol=1e-4, atol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_homogeneity_completeness_vmeasure(self, seed):
+        from sklearn.metrics import (completeness_score,
+                                     homogeneity_score, v_measure_score)
+
+        rng = np.random.default_rng(30 + seed)
+        n = int(rng.integers(30, 400))
+        t, p = _labels(rng, n, 5), _labels(rng, n, 5)
+        np.testing.assert_allclose(float(stats.homogeneity_score(t, p)),
+                                   homogeneity_score(t, p),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(stats.completeness_score(t, p)),
+                                   completeness_score(t, p),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(stats.v_measure(t, p)),
+                                   v_measure_score(t, p),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_silhouette(self, seed):
+        from sklearn.metrics import silhouette_score
+
+        rng = np.random.default_rng(40 + seed)
+        n = int(rng.integers(40, 300))
+        d = int(rng.integers(2, 20))
+        k = int(rng.integers(2, 6))
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        lab = _labels(rng, n, k)
+        # every cluster non-empty for sklearn
+        lab[:k] = np.arange(k)
+        got = float(stats.silhouette_score(X, lab, n_clusters=k,
+                                           metric="euclidean"))
+        want = silhouette_score(X, lab)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_trustworthiness(self, seed):
+        from sklearn.manifold import trustworthiness
+
+        rng = np.random.default_rng(50 + seed)
+        n = int(rng.integers(40, 200))
+        X = rng.normal(size=(n, 16)).astype(np.float32)
+        E = X[:, :4] + 0.1 * rng.normal(size=(n, 4)).astype(np.float32)
+        nn = int(rng.integers(3, min(12, (n - 1) // 2)))
+        got = float(stats.trustworthiness_score(X, E, n_neighbors=nn))
+        want = trustworthiness(X, E, n_neighbors=nn)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestRegressionClassificationParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_r2(self, seed):
+        from sklearn.metrics import r2_score
+
+        rng = np.random.default_rng(60 + seed)
+        n = int(rng.integers(10, 500))
+        y = rng.normal(size=n).astype(np.float32)
+        yh = y + 0.3 * rng.normal(size=n).astype(np.float32)
+        np.testing.assert_allclose(float(stats.r2_score(y, yh)),
+                                   r2_score(y, yh), rtol=1e-3, atol=1e-4)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_regression_metrics(self, seed):
+        from sklearn.metrics import (mean_absolute_error,
+                                     mean_squared_error)
+
+        rng = np.random.default_rng(70 + seed)
+        n = int(rng.integers(10, 500))
+        y = rng.normal(size=n).astype(np.float32)
+        yh = y + 0.3 * rng.normal(size=n).astype(np.float32)
+        mae, mse, med = stats.regression_metrics(yh, y)
+        np.testing.assert_allclose(float(mae), mean_absolute_error(y, yh),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(mse), mean_squared_error(y, yh),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(float(med),
+                                   np.median(np.abs(y - yh)),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_accuracy(self, seed):
+        from sklearn.metrics import accuracy_score
+
+        rng = np.random.default_rng(80 + seed)
+        n = int(rng.integers(10, 400))
+        a, b = _labels(rng, n, 4), _labels(rng, n, 4)
+        np.testing.assert_allclose(float(stats.accuracy(a, b)),
+                                   accuracy_score(b, a), rtol=1e-6)
+
+
+class TestKmeansQualityParity:
+    @pytest.mark.parametrize("seed", range(2))
+    def test_inertia_vs_sklearn(self, seed):
+        """Lloyd from k-means++ must land within 10% of sklearn's
+        inertia on blob data (pylibraft test_kmeans.py style)."""
+        from sklearn.cluster import KMeans
+
+        from raft_tpu.cluster import kmeans
+        from raft_tpu.cluster.kmeans_types import KMeansParams
+
+        rng = np.random.default_rng(90 + seed)
+        centers = rng.normal(size=(6, 8)).astype(np.float32) * 5
+        X = (centers[rng.integers(0, 6, 1200)]
+             + rng.normal(size=(1200, 8)).astype(np.float32))
+        centroids, inertia, _ = kmeans.fit(
+            KMeansParams(n_clusters=6, max_iter=50, n_init=2), X)
+        sk = KMeans(n_clusters=6, n_init=2, max_iter=50,
+                    random_state=0).fit(X)
+        assert float(inertia) <= sk.inertia_ * 1.1, (
+            float(inertia), sk.inertia_)
+
+    def test_silhouette_of_balanced_fit(self):
+        """Balanced k-means labels must score a positive silhouette on
+        separable blobs — an end-to-end clustering-quality pin."""
+        from sklearn.metrics import silhouette_score
+
+        from raft_tpu.cluster import kmeans_balanced
+        from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+
+        rng = np.random.default_rng(99)
+        centers = rng.normal(size=(8, 12)).astype(np.float32) * 8
+        X = (centers[rng.integers(0, 8, 2000)]
+             + rng.normal(size=(2000, 12)).astype(np.float32))
+        p = KMeansBalancedParams(n_iters=10)
+        c = kmeans_balanced.fit(p, X, 8)
+        lab = np.asarray(kmeans_balanced.predict(p, c, X))
+        assert silhouette_score(X, lab) > 0.5
