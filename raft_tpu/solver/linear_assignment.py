@@ -84,12 +84,18 @@ def _auction_solve(cost, max_rounds: int):
             (row_of_col, col_of_row, prices, jnp.int32(0)))
         return (prices, col_of_row), col_of_row
 
-    # ε-scaling schedule: eps from span/2 down to span·1e-6/n — n·ε bounds
-    # the suboptimality, so the floor keeps the result within ~1e-6·span of
-    # optimal (float costs; the reference's integral Hungarian is exact).
+    # ε-scaling schedule: eps from span/2 down to a floor of span·4e-6.
+    # The floor is set by f32 price resolution, NOT by the optimality
+    # target: prices reach ~2·span, where one ulp ≈ 2.4e-7·span — an eps
+    # below that makes `prices += bid` a no-op and two rows bid forever
+    # for the same column (observed: the auction stalled with unassigned
+    # rows at any round budget and the greedy repair returned a 46%%
+    # suboptimal matching). n·ε bounds the suboptimality, so the floor
+    # keeps the result within ~4e-6·n·span of optimal (float costs; the
+    # reference's integral Hungarian is exact).
     n_phases = 12
     eps_list = span / 2.0 / (6.0 ** jnp.arange(n_phases))
-    eps_list = jnp.maximum(eps_list, span * 1e-6 / (n + 1))
+    eps_list = jnp.maximum(eps_list, span * 4e-6)
     (prices, col_of_row), hist = lax.scan(
         scale_phase, (jnp.zeros((n,), cost.dtype), jnp.full((n,), -1, jnp.int32)),
         eps_list)

@@ -1,0 +1,134 @@
+"""Statistical property tests for the random module: every distribution's
+sample stream must pass a Kolmogorov–Smirnov test against its scipy
+reference CDF (and discrete/bernoulli against exact frequencies) — the
+reference's cpp/test/random/rng.cu runs the same mean/std/KS checks per
+generator type.
+"""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from raft_tpu.random import RngState, rng as rngmod
+
+N = 20_000
+ALPHA = 1e-3   # KS p-value floor: fixed seeds make this deterministic
+
+
+def _ks(samples, cdf, *args):
+    return scipy.stats.kstest(np.asarray(samples), cdf, args=args).pvalue
+
+
+class TestContinuousDistributions:
+    def test_uniform(self):
+        s = rngmod.uniform(RngState(seed=1), (N,), -2.0, 3.0)
+        assert _ks(s, "uniform", -2.0, 5.0) > ALPHA
+
+    def test_normal(self):
+        s = rngmod.normal(RngState(seed=2), (N,), 1.5, 2.0)
+        assert _ks(s, "norm", 1.5, 2.0) > ALPHA
+
+    def test_lognormal(self):
+        s = rngmod.lognormal(RngState(seed=3), (N,), 0.5, 0.7)
+        assert _ks(s, "lognorm", 0.7, 0, np.exp(0.5)) > ALPHA
+
+    def test_exponential(self):
+        s = rngmod.exponential(RngState(seed=4), (N,), 1.8)
+        # raft's exponential(lambda): scale = 1/lambda
+        assert _ks(s, "expon", 0, 1 / 1.8) > ALPHA
+
+    def test_gumbel(self):
+        s = rngmod.gumbel(RngState(seed=5), (N,), 0.4, 1.3)
+        assert _ks(s, "gumbel_r", 0.4, 1.3) > ALPHA
+
+    def test_logistic(self):
+        s = rngmod.logistic(RngState(seed=6), (N,), 0.2, 0.9)
+        assert _ks(s, "logistic", 0.2, 0.9) > ALPHA
+
+    def test_laplace(self):
+        s = rngmod.laplace(RngState(seed=7), (N,), -0.3, 1.1)
+        assert _ks(s, "laplace", -0.3, 1.1) > ALPHA
+
+    def test_rayleigh(self):
+        s = rngmod.rayleigh(RngState(seed=8), (N,), 1.6)
+        assert _ks(s, "rayleigh", 0, 1.6) > ALPHA
+
+
+class TestDiscreteDistributions:
+    def test_bernoulli_frequency(self):
+        p = 0.37
+        s = np.asarray(rngmod.bernoulli(RngState(seed=9),
+                                        (N,), p))
+        f = s.mean()
+        # 5-sigma binomial bound
+        assert abs(f - p) < 5 * np.sqrt(p * (1 - p) / N), f
+
+    def test_discrete_matches_weights(self):
+        import jax.numpy as jnp
+
+        w = jnp.asarray([0.1, 0.5, 0.15, 0.25])
+        s = np.asarray(rngmod.discrete(RngState(seed=10),
+                                       (N,), w))
+        freq = np.bincount(s, minlength=4) / N
+        np.testing.assert_allclose(freq, np.asarray(w), atol=0.02)
+
+    def test_uniform_int_range_and_flatness(self):
+        s = np.asarray(rngmod.uniformInt(RngState(seed=11),
+                                         (N,), 5, 25))
+        assert s.min() >= 5 and s.max() < 25
+        freq = np.bincount(s - 5, minlength=20) / N
+        np.testing.assert_allclose(freq, 1 / 20, atol=0.02)
+
+    def test_sample_without_replacement_uniformity(self):
+        """Each item's inclusion frequency over repeated draws must be
+        ~k/n (the weighted-reservoir property at uniform weights)."""
+        n, k, reps = 50, 10, 300
+        counts = np.zeros(n)
+        state = RngState(seed=12)
+        for _ in range(reps):
+            _, out = rngmod.sample_without_replacement(state, n, k)
+            out = np.asarray(out)
+            assert len(np.unique(out)) == k          # no replacement
+            counts[out] += 1
+        freq = counts / reps
+        np.testing.assert_allclose(freq, k / n, atol=0.08)
+
+    def test_permute_is_permutation(self):
+        s = np.asarray(rngmod.permute(RngState(seed=13), 400))
+        assert np.array_equal(np.sort(s), np.arange(400))
+
+
+class TestMultivariate:
+    def test_multi_variable_gaussian_covariance(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        cov = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+        mu = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+        s = np.asarray(rngmod.multi_variable_gaussian(
+            RngState(seed=14), jnp.asarray(mu),
+            jnp.asarray(cov), 30_000))
+        np.testing.assert_allclose(s.mean(0), mu, atol=0.1)
+        np.testing.assert_allclose(np.cov(s.T), cov, rtol=0.1, atol=0.3)
+
+
+class TestSolverProperties:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_lap_matches_scipy(self, seed):
+        from scipy.optimize import linear_sum_assignment
+
+        from raft_tpu.solver import lap
+
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(3, 40))
+        cost = rng.uniform(0, 10, size=(n, n)).astype(np.float32)
+        assign, total = lap(cost)
+        assign = np.asarray(assign)
+        got = cost[np.arange(n), assign].sum()
+        np.testing.assert_allclose(float(total), got, rtol=1e-5)
+        r, c = linear_sum_assignment(cost)
+        want = cost[r, c].sum()
+        # auction solves to epsilon-optimality
+        assert got <= want * 1.05 + 0.1, (got, want)
+        assert len(np.unique(assign)) == n             # a permutation
