@@ -1,0 +1,128 @@
+"""Randomized-shape property tests for the matrix-ops layer against
+numpy oracles (the reference's cpp/test/matrix/*.cu grids sweep sizes per
+op; these sweep seeded random shapes including non-128-aligned ones so
+padding paths are exercised)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu import matrix
+
+
+def _rand(rng, *shape):
+    return rng.normal(size=shape).astype(np.float32)
+
+
+class TestMatrixOpProperties:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_argminmax(self, seed):
+        rng = np.random.default_rng(seed)
+        m, n = int(rng.integers(1, 70)), int(rng.integers(1, 300))
+        a = _rand(rng, m, n)
+        np.testing.assert_array_equal(np.asarray(matrix.argmax(a)),
+                                      a.argmax(1))
+        np.testing.assert_array_equal(np.asarray(matrix.argmin(a)),
+                                      a.argmin(1))
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_gather_scatter_roundtrip(self, seed):
+        rng = np.random.default_rng(10 + seed)
+        m, n = int(rng.integers(4, 100)), int(rng.integers(2, 40))
+        a = _rand(rng, m, n)
+        k = int(rng.integers(1, m + 1))
+        idx = rng.choice(m, size=k, replace=False).astype(np.int32)
+        g = np.asarray(matrix.gather(a, idx))
+        np.testing.assert_array_equal(g, a[idx])
+        # scatter the gathered rows back to their source positions
+        out = np.asarray(matrix.scatter(jnp.asarray(a), jnp.asarray(idx),
+                                        jnp.asarray(g)))
+        np.testing.assert_array_equal(out, a)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_col_wise_sort(self, seed):
+        rng = np.random.default_rng(20 + seed)
+        m, n = int(rng.integers(2, 80)), int(rng.integers(1, 30))
+        a = _rand(rng, m, n)
+        s = np.asarray(matrix.col_wise_sort(a))
+        np.testing.assert_array_equal(s, np.sort(a, axis=0))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_reverse_slice_triangular(self, seed):
+        rng = np.random.default_rng(30 + seed)
+        m, n = int(rng.integers(3, 60)), int(rng.integers(3, 60))
+        a = _rand(rng, m, n)
+        np.testing.assert_array_equal(
+            np.asarray(matrix.reverse(a, along_rows=False)), a[::-1])
+        np.testing.assert_array_equal(
+            np.asarray(matrix.reverse(a, along_rows=True)), a[:, ::-1])
+        r0, r1 = sorted(rng.integers(0, m, 2))
+        c0, c1 = sorted(rng.integers(0, n, 2))
+        r1, c1 = r1 + 1, c1 + 1
+        np.testing.assert_array_equal(
+            np.asarray(matrix.slice_(a, r0, c0, r1, c1)),
+            a[r0:r1, c0:c1])
+        k = min(m, n)
+        sq = a[:k, :k]
+        np.testing.assert_array_equal(
+            np.asarray(matrix.triangular_upper(sq)), np.triu(sq))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_sign_flip_columns_positive_max(self, seed):
+        """sign_flip: each column's max-|value| entry ends positive (the
+        deterministic-SVD-sign convention, matrix/math.cuh signFlip)."""
+        rng = np.random.default_rng(40 + seed)
+        m, n = int(rng.integers(2, 50)), int(rng.integers(1, 20))
+        a = _rand(rng, m, n)
+        f = np.asarray(matrix.sign_flip(a))
+        for j in range(n):
+            i = np.abs(f[:, j]).argmax()
+            assert f[i, j] >= 0
+            np.testing.assert_allclose(np.abs(f[:, j]), np.abs(a[:, j]),
+                                       rtol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_linewise_row_and_col(self, seed):
+        rng = np.random.default_rng(50 + seed)
+        m, n = int(rng.integers(2, 60)), int(rng.integers(2, 60))
+        a = _rand(rng, m, n)
+        vrow = _rand(rng, n)
+        vcol = _rand(rng, m)
+        got_r = np.asarray(matrix.linewise_op(a, vrow, op=jnp.add,
+                                              along_lines=True))
+        np.testing.assert_allclose(got_r, a + vrow[None, :], rtol=1e-6)
+        got_c = np.asarray(matrix.linewise_op(a, vcol, op=jnp.multiply,
+                                              along_lines=False))
+        np.testing.assert_allclose(got_c, a * vcol[:, None], rtol=1e-6)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_gather_if(self, seed):
+        rng = np.random.default_rng(60 + seed)
+        m, n = int(rng.integers(5, 60)), int(rng.integers(2, 20))
+        a = _rand(rng, m, n)
+        k = int(rng.integers(1, m))
+        idx = rng.integers(0, m, size=k).astype(np.int32)
+        stencil = rng.integers(0, 2, size=k).astype(np.int32)
+        got = np.asarray(matrix.gather_if(a, idx, stencil,
+                                          pred_op=lambda s: s > 0,
+                                          fallback=0.0))
+        want = np.where((stencil > 0)[:, None], a[idx], 0.0)
+        np.testing.assert_array_equal(got, want)
+
+    def test_l2_norm_matches_numpy(self):
+        rng = np.random.default_rng(70)
+        a = _rand(rng, 37, 53)
+        np.testing.assert_allclose(float(matrix.l2_norm(a)),
+                                   np.sqrt((a ** 2).sum()), rtol=1e-5)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_shift_fill(self, seed):
+        rng = np.random.default_rng(80 + seed)
+        m, n = int(rng.integers(2, 40)), int(rng.integers(3, 40))
+        a = _rand(rng, m, n)
+        k = int(rng.integers(1, n))
+        got = np.asarray(matrix.shift_fill(a, k, fill_value=-1.0))
+        want = np.concatenate(
+            [np.full((m, k), -1.0, np.float32), a[:, :-k]], axis=1)
+        np.testing.assert_array_equal(got, want)
