@@ -1,0 +1,106 @@
+"""Randomized-shape descriptive-stats properties vs numpy/scipy oracles
+(the reference's cpp/test/stats/{mean,stddev,cov,histogram,minmax}.cu
+size grids, swept over seeded random shapes), plus a randomized
+ball-cover-vs-brute-force kNN grid."""
+
+import numpy as np
+import pytest
+import scipy.stats
+
+from raft_tpu import stats
+
+
+class TestDescriptiveProperties:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_meanvar_cov(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(3, 400))
+        d = int(rng.integers(1, 60))
+        X = rng.normal(size=(n, d)).astype(np.float32) * 3 + 1
+        mu, var = stats.meanvar(X, sample=True)
+        np.testing.assert_allclose(np.asarray(mu), X.mean(0),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(var), X.var(0, ddof=1),
+                                   rtol=1e-3, atol=1e-3)
+        C = np.asarray(stats.cov(X, sample=True))
+        np.testing.assert_allclose(C, np.cov(X.T).reshape(d, d),
+                                   rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_stddev_minmax(self, seed):
+        rng = np.random.default_rng(10 + seed)
+        n, d = int(rng.integers(2, 300)), int(rng.integers(1, 40))
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(stats.stddev(X)),
+                                   X.std(0, ddof=1), rtol=1e-3,
+                                   atol=1e-3)
+        lo, hi = stats.minmax(X)
+        np.testing.assert_array_equal(np.asarray(lo), X.min(0))
+        np.testing.assert_array_equal(np.asarray(hi), X.max(0))
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_histogram_matches_numpy(self, seed):
+        rng = np.random.default_rng(20 + seed)
+        n = int(rng.integers(50, 3000))
+        bins = int(rng.integers(2, 40))
+        x = rng.normal(size=n).astype(np.float32)
+        lo, hi = float(x.min()), float(x.max()) + 1e-5
+        # histogram is per-column (the reference's matrix form): a 1-D
+        # input yields (n_bins, 1).
+        got = np.asarray(stats.histogram(x, bins, lower=lo,
+                                         upper=hi)).ravel()
+        want, _ = np.histogram(x, bins=bins, range=(lo, hi))
+        # bin-edge rounding in f32 may move a boundary sample by one bin
+        assert np.abs(got.astype(int) - want).sum() <= 2, (got, want)
+        assert got.sum() == n
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_entropy_matches_scipy(self, seed):
+        rng = np.random.default_rng(30 + seed)
+        n, k = int(rng.integers(20, 500)), int(rng.integers(2, 9))
+        lab = rng.integers(0, k, size=n).astype(np.int32)
+        got = float(stats.entropy(lab, n_classes=k))
+        freq = np.bincount(lab, minlength=k) / n
+        want = scipy.stats.entropy(freq)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_weighted_means(self, seed):
+        rng = np.random.default_rng(40 + seed)
+        n, d = int(rng.integers(2, 100)), int(rng.integers(1, 30))
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        w_row = np.abs(rng.normal(size=d)).astype(np.float32) + 0.1
+        w_col = np.abs(rng.normal(size=n)).astype(np.float32) + 0.1
+        got_r = np.asarray(stats.row_weighted_mean(X, w_row))
+        np.testing.assert_allclose(got_r, (X * w_row).sum(1) / w_row.sum(),
+                                   rtol=1e-4, atol=1e-4)
+        got_c = np.asarray(stats.col_weighted_mean(X, w_col))
+        np.testing.assert_allclose(
+            got_c, (X * w_col[:, None]).sum(0) / w_col.sum(),
+            rtol=1e-4, atol=1e-4)
+
+
+class TestBallCoverProperties:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_matches_brute_force(self, seed):
+        """Ball cover must return exact kNN (triangle-inequality pruning
+        is lossless) on random 2/3-D data at random sizes."""
+        from raft_tpu.neighbors import ball_cover, brute_force
+
+        rng = np.random.default_rng(50 + seed)
+        n = int(rng.integers(200, 2500))
+        d = int(rng.integers(2, 4))          # 2-D or 3-D (the ref's scope)
+        k = int(rng.integers(1, 16))
+        db = rng.normal(size=(n, d)).astype(np.float32)
+        q = rng.normal(size=(40, d)).astype(np.float32)
+        idx = ball_cover.build_index(db)
+        bd, bi = ball_cover.knn_query(idx, q, k)
+        ed, ei = brute_force.knn(db, q, k,
+                                 metric="euclidean")
+        agree = np.mean([
+            len(np.intersect1d(np.asarray(bi)[r], np.asarray(ei)[r])) / k
+            for r in range(40)])
+        assert agree > 0.99, agree
+        np.testing.assert_allclose(np.sort(np.asarray(bd), 1),
+                                   np.sort(np.asarray(ed), 1),
+                                   rtol=1e-3, atol=1e-3)
