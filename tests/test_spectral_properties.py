@@ -1,0 +1,98 @@
+"""Spectral partition + Lanczos property tests on randomized planted
+graphs (the reference's cpp/test/sparse/spectral_matrix.cu /
+cluster/spectral.cu style: planted partitions must be recovered; the
+Lanczos extremal eigenpairs must match scipy's on the same operator)."""
+
+import numpy as np
+import pytest
+import scipy.sparse
+import scipy.sparse.linalg
+
+import jax.numpy as jnp
+
+from raft_tpu.sparse.solver import (lanczos_largest_eigenpairs,
+                                    lanczos_smallest_eigenpairs)
+from raft_tpu.sparse.types import CSR
+
+
+def _csr(sp):
+    sp = sp.tocsr().astype(np.float32)
+    return CSR(jnp.asarray(sp.indptr.astype(np.int32)),
+               jnp.asarray(sp.indices.astype(np.int32)),
+               jnp.asarray(sp.data), sp.shape)
+
+
+def _planted_graph(rng, n_blocks, block, p_in=0.4, p_out=0.01):
+    n = n_blocks * block
+    rows, cols = [], []
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i // block) == (j // block)
+            if rng.random() < (p_in if same else p_out):
+                rows += [i, j]
+                cols += [j, i]
+    a = scipy.sparse.csr_matrix(
+        (np.ones(len(rows), np.float32), (rows, cols)), shape=(n, n))
+    return a
+
+
+class TestLanczosProperties:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_largest_matches_scipy(self, seed):
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(30, 200))
+        dens = scipy.sparse.random(n, n, density=0.1, random_state=seed,
+                                   dtype=np.float64)
+        sym = (dens + dens.T) * 0.5
+        sym = sym + scipy.sparse.eye(n) * 2
+        k = int(rng.integers(1, 5))
+        w, v = lanczos_largest_eigenpairs(_csr(sym), k)
+        want = scipy.sparse.linalg.eigsh(
+            sym.tocsc().astype(np.float64), k=k, which="LA",
+            return_eigenvectors=False)
+        np.testing.assert_allclose(np.sort(np.asarray(w)),
+                                   np.sort(want), rtol=1e-2, atol=1e-2)
+
+    @pytest.mark.parametrize("seed", range(3))
+    def test_smallest_matches_scipy(self, seed):
+        rng = np.random.default_rng(40 + seed)
+        n = int(rng.integers(30, 150))
+        dens = scipy.sparse.random(n, n, density=0.15, random_state=seed,
+                                   dtype=np.float64)
+        sym = (dens + dens.T) * 0.5 + scipy.sparse.eye(n) * 3
+        k = int(rng.integers(1, 4))
+        w, v = lanczos_smallest_eigenpairs(_csr(sym), k)
+        want = scipy.sparse.linalg.eigsh(
+            sym.tocsc().astype(np.float64), k=k, which="SA",
+            return_eigenvectors=False)
+        np.testing.assert_allclose(np.sort(np.asarray(w)),
+                                   np.sort(want), rtol=2e-2, atol=2e-2)
+
+    def test_eigenvector_residual(self):
+        rng = np.random.default_rng(7)
+        n = 80
+        dens = scipy.sparse.random(n, n, density=0.2, random_state=7,
+                                   dtype=np.float64)
+        sym = ((dens + dens.T) * 0.5 + scipy.sparse.eye(n) * 2).tocsr()
+        w, v = lanczos_largest_eigenpairs(_csr(sym), 3)
+        w, v = np.asarray(w), np.asarray(v)
+        A = sym.toarray().astype(np.float64)
+        for i in range(3):
+            r = A @ v[:, i] - w[i] * v[:, i]
+            assert np.linalg.norm(r) < 5e-2 * max(abs(w[i]), 1), i
+
+
+class TestSpectralPartitionProperties:
+    @pytest.mark.parametrize("seed", range(3))
+    def test_recovers_planted_blocks(self, seed):
+        from sklearn.metrics import adjusted_rand_score
+
+        from raft_tpu.spectral import partition as _partition_fn
+
+        rng = np.random.default_rng(100 + seed)
+        n_blocks, block = 3, 30
+        A = _planted_graph(rng, n_blocks, block)
+        labels, _, _ = _partition_fn(_csr(A), n_blocks)
+        truth = np.repeat(np.arange(n_blocks), block)
+        ari = adjusted_rand_score(truth, np.asarray(labels))
+        assert ari > 0.8, ari
