@@ -320,7 +320,7 @@ def _family_1m():
 
     # Sharded sanity at 1M (VERDICT r5 item 1 "done" bar): the same index
     # on a 1-device mesh must track single-chip QPS — the sharded body
-    # now runs the production cells engine + an all_gather merge.
+    # now runs the production cells engine + the merge collective.
     from jax.sharding import Mesh
 
     from raft_tpu.parallel import ShardedIvfFlat, sharded_ivf_flat_search
@@ -567,6 +567,17 @@ def _family_10m():
           build_s=round(build_s, 1), spread_pct=round(spread, 1))
 
 
+def _family_sharded():
+    """Merge-engine metrics for the sharded search paths (ISSUE 1): QPS +
+    estimated per-device exchange bytes per engine (allgather | ring |
+    ring_bf16) over the full mesh, so the BENCH trajectory tracks the
+    hierarchical merge collective's comm-volume win. Body lives in
+    bench/sharded.py (shared with the tier-1 smoke test)."""
+    from bench.sharded import run
+
+    run(quick=False)
+
+
 def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
     rng = np.random.default_rng(seed)
     db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
@@ -663,6 +674,7 @@ def main():
     enable_compilation_cache()
     _run_family(_family, "bench_family_error")
     if "--no-1m" not in sys.argv:
+        _run_family(_family_sharded, "bench_sharded_error")
         _run_family(_family_1m, "bench_1m_error")
         _run_family(_family_sift1m_u8, "bench_sift1m_error")
         _run_family(_family_4m, "bench_4m_error")

@@ -1,0 +1,98 @@
+"""Sharded-search merge-engine bench family (ISSUE 1 bench satellite).
+
+Measures ``sharded_knn`` and sharded IVF-Flat search QPS per merge
+engine — allgather | ring | ring_bf16 — over the full device mesh, and
+reports each engine's estimated per-device collective exchange bytes
+(:func:`raft_tpu.comms.topk_merge.merge_comm_bytes`) so the BENCH
+trajectory records the comm-volume win alongside the throughput. One
+JSON row per (algo, engine), bench.py-style.
+
+``quick=True`` is the CI smoke shape (tiny db, few repeats, runs on the
+8-virtual-CPU-device mesh in tier-1); the full shape is the tracked
+bench family wired into bench.py.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 1), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _qps(fn, q, reps, rounds):
+    """Pipelined eager dispatch + one fence per round, RTT-corrected —
+    the bench.py _eager_qps protocol (sharded searches are eager calls
+    around a jitted shard_map)."""
+    from bench.common import fence, link_rtt
+
+    fence(fn(q))  # compile + warm
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(q)
+        fence(out)
+        times.append((time.perf_counter() - t0 - link_rtt()) / reps)
+    return q.shape[0] / float(np.median(times))
+
+
+def run(quick: bool = False) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.topk_merge import merge_comm_bytes
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                   sharded_ivf_flat_search, sharded_knn)
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    n_dev = devs.size
+    rng = np.random.default_rng(3)
+
+    if quick:
+        n, d, nq, k, reps, rounds = 1024, 16, 32, 10, 2, 2
+        n_lists, n_probes = 16, 8
+    else:
+        n, d, nq, k, reps, rounds = 262_144, 128, 1024, 100, 8, 5
+        n_lists, n_probes = 256, 32
+    n -= n % n_dev
+    shard = n // n_dev
+
+    db = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    q = jnp.asarray(rng.normal(size=(nq, d)).astype(np.float32))
+
+    for engine in ("allgather", "ring", "ring_bf16"):
+        qps = _qps(lambda qq, e=engine: sharded_knn(
+            mesh, db, qq, k, merge_engine=e), q, reps, rounds)
+        _emit("sharded_knn_qps", qps, "qps", engine=engine,
+              mesh_devices=n_dev, n_db=n, dim=d, k=k,
+              est_exchange_bytes=merge_comm_bytes(
+                  engine, nq, k, min(k, shard), n_dev))
+
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4)
+    sharded = sharded_ivf_flat_build(mesh, params, db)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    cap = int(sharded.indices.shape[1] * sharded.indices.shape[2])
+    for engine in ("allgather", "ring", "ring_bf16"):
+        qps = _qps(lambda qq, e=engine: sharded_ivf_flat_search(
+            mesh, sp, sharded, qq, k, merge_engine=e), q, reps, rounds)
+        _emit("sharded_ivf_flat_qps", qps, "qps", engine=engine,
+              mesh_devices=n_dev, n_db=n, dim=d, k=k, n_probes=n_probes,
+              est_exchange_bytes=merge_comm_bytes(
+                  engine, nq, k, min(k, cap), n_dev))
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

@@ -9,6 +9,13 @@ from raft_tpu.comms.comms import (
     build_comms,
     inject_comms_on_handle,
 )
+from raft_tpu.comms.topk_merge import (
+    MERGE_ENGINES,
+    merge_comm_bytes,
+    merge_parts,
+    resolve_merge_engine,
+    topk_merge,
+)
 from raft_tpu.comms.comms_test import (
     test_collective_allreduce,
     test_collective_allreduce_prod,
@@ -26,6 +33,8 @@ from raft_tpu.comms.comms_test import (
 __all__ = [
     "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
     "inject_comms_on_handle",
+    "MERGE_ENGINES", "merge_comm_bytes", "merge_parts",
+    "resolve_merge_engine", "topk_merge",
     "test_collective_allreduce", "test_collective_allreduce_prod",
     "test_collective_gatherv", "test_collective_broadcast",
     "test_collective_reduce", "test_collective_allgather",

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from raft_tpu.util.shard_map_compat import axis_size
+
 
 class DatatypeT(enum.Enum):
     """Ref: comms_t::datatype_t (core/comms.hpp:33). JAX arrays carry their
@@ -87,7 +89,7 @@ class Comms:
             for a in axes:
                 n *= self.mesh.shape[a]
             return n
-        return lax.axis_size(self.axis)
+        return axis_size(self.axis)
 
     def get_rank(self):
         """Ref: comms_t::get_rank. Only meaningful inside shard_map."""
@@ -211,14 +213,14 @@ class Comms:
     def device_sendrecv(self, x, dest: int, source: int):
         """Paired send/recv (ref: comms_t::device_sendrecv,
         core/comms.hpp) — expressed as a ppermute over the send edges."""
-        size = self.get_size() if self.mesh is not None else lax.axis_size(self.axis)
+        size = self.get_size() if self.mesh is not None else axis_size(self.axis)
         perm = [(i, (i + dest - source) % size) for i in range(size)]
         return lax.ppermute(x, self.axis, perm)
 
     def shift(self, x, offset: int = 1):
         """Ring shift by ``offset`` (the ppermute idiom behind
         neighbor exchanges)."""
-        size = self.get_size() if self.mesh is not None else lax.axis_size(self.axis)
+        size = self.get_size() if self.mesh is not None else axis_size(self.axis)
         perm = [(i, (i + offset) % size) for i in range(size)]
         return lax.ppermute(x, self.axis, perm)
 

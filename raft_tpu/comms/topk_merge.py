@@ -1,0 +1,307 @@
+"""Hierarchical top-k merge collectives for sharded search.
+
+Ref: the reference merges per-rank kNN results with ``knn_merge_parts``
+(neighbors/brute_force.cuh:80) after a plain allgather of candidates
+(docs/source/using_comms.rst; SURVEY.md §2.12 item 4). Our sharded
+consumers used to do the same — ``lax.all_gather`` every device's
+(distances, ids) and re-sort the full candidate set on every device:
+O(q·kk·n_dev) bytes received per device plus a replicated select over
+n_dev·kk candidates.
+
+This module folds the k-selection *into* the collective's steps, the
+"fused computation-collective" recipe (arxiv 2305.06942), with an opt-in
+bf16-quantized distance exchange in the spirit of EQuARX (arxiv
+2506.17615) — ids stay exact int32/int64 and a final exact-distance
+re-rank of the surviving candidates guards recall.
+
+Engines (``topk_merge(..., engine=...)``, call INSIDE ``shard_map``):
+
+* ``"allgather"`` — the baseline: one ``all_gather``, one replicated
+  select. Bytes received per device: ``(n_dev-1)·q·kk·(4+idx)``.
+* ``"ring"`` — pairwise-merge collective. On a power-of-two axis it runs
+  the log-step butterfly (recursive doubling): step ``s`` exchanges the
+  running top-w with the partner at distance ``2^s`` over ``ppermute``
+  and pairwise-merges, ``w`` growing ``kk·2^(s+1)`` but capped at the
+  final ``k``; total bytes ≈ ``log2(n_dev)·q·k·(4+idx)``. On a
+  non-power-of-two axis it falls back to the linear ring (store-and-
+  forward each neighbor's original candidates, merging every hop):
+  ``(n_dev-1)·q·kk·(4+idx)`` bytes — same volume as allgather, but the
+  select work distributes across steps instead of replicating one big
+  sort. Results are IDENTICAL to the allgather engine: every engine
+  selects under the same total order (distance, then lowest id), which
+  makes hierarchical pairwise merging associative even under ties.
+* ``"ring_bf16"`` — the ring engine with the exchanged distances
+  quantized to bfloat16 (half the distance bytes; ids stay exact). The
+  ring carries a guard margin of ``min(2k, n_dev·kk)`` candidates, and
+  after the collective each device contributes the EXACT distances of
+  the survivors it owns (a ``pmin``/``pmax`` reduction — every survivor
+  came from exactly one device's local list), so reported distances are
+  exact and a true top-k member is lost only if bf16 rounding pushes it
+  below rank 2k. Opt-in: never chosen by "auto".
+* ``"auto"`` — heuristics keyed on (q, k, n_dev); see
+  :func:`resolve_merge_engine`.
+
+The same pairwise-merge core also serves the single-host
+``knn_merge_parts`` path (:func:`merge_parts`), with the tie order keyed
+by concatenated position so it reproduces the historical
+concat+select_k result bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from raft_tpu.core.error import expects
+from raft_tpu.util.pow2 import is_pow2
+from raft_tpu.util.shard_map_compat import axis_size as _axis_size
+
+MERGE_ENGINES = ("auto", "allgather", "ring", "ring_bf16")
+
+# auto crossover: below this many merged candidate scalars the latency of
+# a multi-step ring chain beats its bandwidth/distributed-select win on
+# the linear (non-pow2) topology, where ring moves the same bytes as
+# allgather (see resolve_merge_engine).
+_RING_MIN_WORK = 1 << 16
+
+
+def resolve_merge_engine(engine: str, n_queries: int, k: int,
+                         n_dev: int) -> str:
+    """Resolve "auto" to a concrete engine from (q, k, n_dev).
+
+    Rules (documented in docs/sharded_search.md):
+
+    * ``n_dev <= 2`` → "allgather": a single exchange already moves the
+      minimum bytes; a ring adds steps for nothing.
+    * power-of-two ``n_dev >= 4`` → "ring": the butterfly moves
+      ``log2(n_dev)/(n_dev-1)`` of the allgather bytes and distributes
+      the select work.
+    * other ``n_dev`` → "ring" only when the merged candidate volume
+      ``q·k·n_dev`` is large enough (≥ 2^16 scalars) that distributing
+      the select work pays for the longer latency chain; small merges
+      stay on "allgather".
+
+    "auto" never picks "ring_bf16": quantized exchange is a numerics
+    opt-in, not a dispatch decision.
+    """
+    expects(engine in MERGE_ENGINES,
+            f"unknown merge engine {engine!r} (one of {MERGE_ENGINES})")
+    if engine != "auto":
+        return engine
+    if n_dev <= 2:
+        return "allgather"
+    if is_pow2(n_dev):
+        return "ring"
+    return "ring" if n_queries * k * n_dev >= _RING_MIN_WORK else "allgather"
+
+
+def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
+                     n_dev: int, idx_bytes: int = 4) -> int:
+    """Estimated collective bytes RECEIVED per device for one merge.
+
+    ``kk`` is the per-device candidate width (min(k, shard capacity)).
+    The estimate covers the exchanged (distances, ids) payloads; the
+    bf16 engine adds the exact-re-rank reduction (counted as one
+    ring-allreduce of the survivor row at its guard width
+    ``cap = min(2k, n_dev·kk)``: ``2·q·cap·4`` bytes).
+    """
+    engine = resolve_merge_engine(engine, n_queries, k, n_dev)
+    if n_dev <= 1:
+        return 0
+    k_out = min(k, n_dev * kk)
+    if engine == "allgather":
+        return (n_dev - 1) * n_queries * kk * (4 + idx_bytes)
+    dist_bytes = 2 if engine == "ring_bf16" else 4
+    cap = min(2 * k_out, n_dev * kk) if engine == "ring_bf16" else k_out
+    if is_pow2(n_dev):
+        total = 0
+        w = kk
+        for _ in range(n_dev.bit_length() - 1):
+            total += n_queries * min(cap, w) * (dist_bytes + idx_bytes)
+            w *= 2
+    else:
+        total = (n_dev - 1) * n_queries * kk * (dist_bytes + idx_bytes)
+    if engine == "ring_bf16":
+        total += 2 * n_queries * cap * 4  # exact re-rank pmin/pmax
+    return total
+
+
+def _ascending_keys(v, select_min: bool):
+    """Map values so ascending sort order == best-first selection order
+    (the polarity mapping of select_k's ``_to_descending_keys``, in
+    native dtype so f64/bf16 keys keep their full resolution)."""
+    if select_min:
+        return v
+    if jnp.issubdtype(v.dtype, jnp.floating):
+        return -v
+    if jnp.issubdtype(v.dtype, jnp.signedinteger):
+        return ~v
+    # unsigned: negation would wrap (key 0 must rank last, not first)
+    return jnp.asarray(jnp.iinfo(v.dtype).max, v.dtype) - v
+
+
+def _sorted_select(d, i, k: int, select_min: bool, tie=None):
+    """Best-first top-k of candidate columns under the shared total order
+    (distance, then ascending tie key — the ids by default). One sort
+    serves every engine, so pairwise-hierarchical merging is associative
+    even under distance ties and all engines agree bit-for-bit. ``d``
+    keeps its dtype (the bf16 ring carries bf16 through the sort)."""
+    keys = _ascending_keys(d, select_min)
+    if tie is None:
+        if select_min:            # keys IS d: two operands suffice
+            out_d, out_i = lax.sort((d, i), dimension=1, num_keys=2)
+        else:
+            _, out_i, out_d = lax.sort((keys, i, d), dimension=1,
+                                       num_keys=2)
+        return out_d[:, :k], out_i[:, :k]
+    _, _, out_d, out_i = lax.sort((keys, tie, d, i), dimension=1, num_keys=2)
+    return out_d[:, :k], out_i[:, :k]
+
+
+def _merge_two(ad, ai, bd, bi, k: int, select_min: bool):
+    """Pairwise merge of two best-first candidate sets — the warp-select
+    merge role of detail/knn_merge_parts.cuh, shared by every engine and
+    by the single-host :func:`merge_parts`."""
+    return _sorted_select(jnp.concatenate([ad, bd], axis=1),
+                          jnp.concatenate([ai, bi], axis=1),
+                          k, select_min)
+
+
+def _ring_merge(dist, idx, cap: int, axis, select_min: bool, n_dev: int):
+    """Fused merge-collective: pairwise top-``cap`` selection inside the
+    ppermute steps. Butterfly (log steps) on a power-of-two axis, linear
+    store-and-forward ring otherwise. Every device finishes with the
+    identical best-first top-``cap`` of the union (total order ties to
+    the lowest id), so the output is replicated by construction."""
+    kk = dist.shape[1]
+    carry_d, carry_i = _sorted_select(dist, idx, min(cap, kk), select_min)
+    if is_pow2(n_dev):
+        for s in range(n_dev.bit_length() - 1):
+            perm = [(j, j ^ (1 << s)) for j in range(n_dev)]
+            recv_d = lax.ppermute(carry_d, axis, perm)
+            recv_i = lax.ppermute(carry_i, axis, perm)
+            w = min(cap, kk * (2 << s))
+            carry_d, carry_i = _merge_two(carry_d, carry_i, recv_d, recv_i,
+                                          w, select_min)
+    else:
+        # Linear ring: forward each neighbor's ORIGINAL candidates around
+        # the ring (store-and-forward) while merging every hop — payload
+        # stays q·kk per step and every device sees every chunk once.
+        perm = [(j, (j + 1) % n_dev) for j in range(n_dev)]
+        send_d, send_i = dist, idx
+        for t in range(n_dev - 1):
+            recv_d = lax.ppermute(send_d, axis, perm)
+            recv_i = lax.ppermute(send_i, axis, perm)
+            w = min(cap, kk * (t + 2))
+            carry_d, carry_i = _merge_two(carry_d, carry_i, recv_d, recv_i,
+                                          w, select_min)
+            send_d, send_i = recv_d, recv_i
+    # Both branches finish with width exactly cap: callers cap at
+    # n_dev·kk and the final merge width is min(cap, kk·n_dev) = cap.
+    return carry_d, carry_i
+
+
+def topk_merge(dist, idx, k: int, axis, select_min: bool = True,
+               engine: str = "allgather") -> Tuple[jax.Array, jax.Array]:
+    """Merge per-device top-``kk`` candidates into the global top-k.
+
+    Call INSIDE ``shard_map`` over ``axis``. ``dist``/``idx`` are this
+    device's ``(n_queries, kk)`` candidates with GLOBAL ids (ids must be
+    unique across devices — each database row lives on one shard).
+    Returns replicated best-first ``(distances, ids)`` of width
+    ``min(k, n_dev·kk)``, ties broken by lowest id. For float32 inputs
+    the "allgather" and "ring" engines return identical arrays;
+    "ring_bf16" additionally re-ranks the survivors with their exact
+    local distances (see module docstring).
+    """
+    expects(dist.ndim == 2 and dist.shape == idx.shape,
+            "dist/idx must be (n_queries, kk) per-device candidates")
+    n_dev = _axis_size(axis)
+    q, kk = dist.shape
+    k_out = min(k, n_dev * kk)
+    engine = resolve_merge_engine(engine, q, k, n_dev)
+
+    if n_dev == 1:
+        return _sorted_select(dist, idx, k_out, select_min)
+
+    if engine == "allgather":
+        all_d = lax.all_gather(dist, axis, axis=1, tiled=True)
+        all_i = lax.all_gather(idx, axis, axis=1, tiled=True)
+        return _sorted_select(all_d, all_i, k_out, select_min)
+
+    if engine == "ring":
+        return _ring_merge(dist, idx, k_out, axis, select_min, n_dev)
+
+    # ring_bf16: quantized exchange with a 2k guard margin, exact re-rank.
+    # The carry STAYS bfloat16 through every ppermute hop (half the
+    # distance bytes on the wire); sorts compare bf16 directly (the bf16
+    # total order is the f32 order restricted to representable values).
+    qd = dist.astype(jnp.bfloat16)
+    cap = min(2 * k_out, n_dev * kk)
+    _, surv_i = _ring_merge(qd, idx, cap, axis, select_min, n_dev)
+    # Exact-distance re-rank: each survivor id lives in exactly one
+    # device's local candidate list; that owner contributes the exact
+    # f32 distance, everyone else the worst value, and a pmin/pmax
+    # recovers the exact distance everywhere.
+    owned = surv_i[:, :, None] == idx[:, None, :]        # (q, cap, kk)
+    local = jnp.min(jnp.where(owned, dist[:, None, :], jnp.inf), axis=2) \
+        if select_min else \
+        jnp.max(jnp.where(owned, dist[:, None, :], -jnp.inf), axis=2)
+    exact = lax.pmin(local, axis) if select_min else lax.pmax(local, axis)
+    return _sorted_select(exact, surv_i, k_out, select_min)
+
+
+def merge_parts(keys, vals, k: Optional[int] = None,
+                select_min: bool = True,
+                translations: Optional[Sequence[int]] = None
+                ) -> Tuple[jax.Array, jax.Array]:
+    """Single-host pairwise-merge core behind ``knn_merge_parts``.
+
+    ``keys``/``vals`` are ``(n_parts, n_queries, kk)``; a binary tree of
+    the same pairwise merge the collectives run reduces them to the
+    global top-``k`` (default ``kk``). Ties are keyed by concatenated
+    position — part-major, the reference's knn_merge_parts order — so
+    the result is bit-for-bit the historical concat+select_k output.
+    """
+    expects(keys.ndim == 3 and vals.shape == keys.shape,
+            "keys/vals must be (n_parts, n_queries, k)")
+    n_parts, n_queries, kk = keys.shape
+    if k is None:
+        k = kk
+    if translations is not None:
+        off = jnp.asarray(translations, vals.dtype).reshape(n_parts, 1, 1)
+        vals = vals + off
+    # Per-part best-first sets with their global (part-major) positions as
+    # tie keys; positions ride the merges as a second payload.
+    base = (jnp.arange(n_parts, dtype=jnp.int32) * kk)[:, None, None]
+    pos = base + jnp.broadcast_to(
+        jnp.arange(kk, dtype=jnp.int32)[None, None, :], keys.shape)
+    items = [(keys[p], pos[p], vals[p]) for p in range(n_parts)]
+    if n_parts == 1:
+        d, v = _sorted_select(keys[0], vals[0], min(k, kk), select_min,
+                              tie=pos[0])
+        return d, v
+    while len(items) > 1:
+        nxt = []
+        for a in range(0, len(items) - 1, 2):
+            (ad, ap, av), (bd, bp, bv) = items[a], items[a + 1]
+            w = min(k, ad.shape[1] + bd.shape[1])
+            cd = jnp.concatenate([ad, bd], axis=1)
+            cp = jnp.concatenate([ap, bp], axis=1)
+            cv = jnp.concatenate([av, bv], axis=1)
+            if select_min:        # keys IS cd: three operands suffice
+                sd, sp, sv = lax.sort((cd, cp, cv), dimension=1,
+                                      num_keys=2)
+            else:
+                _, sp, sd, sv = lax.sort(
+                    (_ascending_keys(cd, select_min), cp, cd, cv),
+                    dimension=1, num_keys=2)
+            nxt.append((sd[:, :w], sp[:, :w], sv[:, :w]))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    out_d, _, out_v = items[0]
+    return out_d[:, :k], out_v[:, :k]

@@ -41,6 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
 from raft_tpu.util.pow2 import ceildiv, round_up_safe
+from raft_tpu.util.pallas_compat import TPUCompilerParams
 from raft_tpu.core.nvtx import traced
 
 
@@ -209,7 +210,7 @@ def _stream_select_min(values, k: int, interpret: bool = False):
             jax.ShapeDtypeStruct((bp, nc * _M), jnp.float32),
             jax.ShapeDtypeStruct((bp, nc * _M), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(values)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raft_tpu.comms.topk_merge import merge_parts
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array, validate_idx_dtype
 from raft_tpu.distance.distance_types import (
@@ -206,20 +207,16 @@ def knn_merge_parts(
     ``translations`` offsets each part's local ids into the global id space.
 
     Returns ``(keys (n_queries, k), values (n_queries, k))``.
+
+    The merge runs the same pairwise-merge core as the multi-device
+    merge collectives (comms/topk_merge.py ``merge_parts``), with ties
+    keyed by concatenated position so the result matches the historical
+    concat+select_k output bit-for-bit.
     """
     keys = as_array(in_keys)
     vals = as_array(in_values)
-    expects(keys.ndim == 3 and vals.shape == keys.shape,
-            "in_keys/in_values must be (n_parts, n_queries, k)")
-    n_parts, n_queries, k = keys.shape
-    if translations is not None:
-        off = jnp.asarray(translations, vals.dtype).reshape(n_parts, 1, 1)
-        vals = vals + off
-    flat_k = keys.transpose(1, 0, 2).reshape(n_queries, n_parts * k)
-    flat_v = vals.transpose(1, 0, 2).reshape(n_queries, n_parts * k)
-    out_k, pos = select_k(flat_k, k, select_min=select_min)
-    out_v = jnp.take_along_axis(flat_v, pos, axis=1)
-    return out_k, out_v
+    return merge_parts(keys, vals, select_min=select_min,
+                       translations=translations)
 
 
 @traced

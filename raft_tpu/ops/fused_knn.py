@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.util.pow2 import round_up_safe
+from raft_tpu.util.pallas_compat import TPUCompilerParams
 
 _LANES = 128
 _I32MAX = jnp.iinfo(jnp.int32).max
@@ -194,7 +195,7 @@ def _fused_knn(queries, db, k: int, l2: bool, sqrt: bool,
             jax.ShapeDtypeStruct((mp, kp), jnp.float32),
             jax.ShapeDtypeStruct((mp, kp), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(queries, db)
@@ -294,7 +295,7 @@ def _fused_batch_knn(queries, db, bad, k: int, l2: bool, sqrt: bool,
             jax.ShapeDtypeStruct((B, mp, kp), jnp.float32),
             jax.ShapeDtypeStruct((B, mp, kp), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=TPUCompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(queries, db, bad)

@@ -12,8 +12,10 @@ the capacity-padded list tensors of *its row shard only* (lists are
 per-shard slices of the same global clusters, so the union of all shards'
 list l is exactly the single-device list l). Search runs as a jitted
 ``shard_map``: each device probes the shared centers, scans its local
-lists, and an ``all_gather`` over ICI merges the per-device top-k —
-communication is O(n_queries·k·n_devices), never the lists themselves.
+lists, and the shared merge collective (comms/topk_merge.py) combines the
+per-device top-k inside its ppermute steps — O(n_queries·k) per step
+(``merge_engine``: allgather | ring | ring_bf16 | auto), never the lists
+themselves.
 Search results are identical to the single-device index built from the
 same model, because the probed candidate set is the same by construction.
 """
@@ -32,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import validate_idx_dtype
 from raft_tpu.distance.distance_types import DistanceType
@@ -145,13 +148,12 @@ def sharded_ivf_flat_build(
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes",
                               "inner_is_l2", "sqrt", "use_cells", "qrows",
-                              "interpret"))
+                              "interpret", "engine"))
 def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
                              mesh, axis, k, n_probes, inner_is_l2, sqrt,
-                             use_cells, qrows, interpret):
+                             use_cells, qrows, interpret, engine):
     # jit around shard_map is load-bearing: un-jitted shard_map runs in the
     # eager SPMD interpreter (~10x slower, measured on the CPU mesh).
-    n_dev = mesh.shape[axis]
 
     def body(data_l, idx_l, sz_l, centers_r, q):
         data_l, idx_l, sz_l = data_l[0], idx_l[0], sz_l[0]
@@ -173,14 +175,12 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
                      if inner_is_l2 else None)
             d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
                                      inner_is_l2, False, probe_ids=probe_ids)
-        all_d = lax.all_gather(d, axis, axis=1, tiled=True)  # (q, n_dev*k)
-        all_i = lax.all_gather(i, axis, axis=1, tiled=True)
-        keys = -all_d if inner_is_l2 else all_d
-        _, pos = lax.top_k(keys, min(k, n_dev * d.shape[1]))
-        out_d = jnp.take_along_axis(all_d, pos, axis=1)
+        # Merge the per-shard top-k inside the collective (topk_merge).
+        out_d, out_i = topk_merge(d, i, k, axis, select_min=inner_is_l2,
+                                  engine=engine)
         if inner_is_l2 and sqrt:
             out_d = jnp.sqrt(out_d)
-        return out_d, jnp.take_along_axis(all_i, pos, axis=1)
+        return out_d, out_i
 
     fn = shard_map(
         body, mesh=mesh,
@@ -191,7 +191,7 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
 
 def sharded_ivf_flat_search(
     mesh: Mesh, params: "_flat.SearchParams", index: ShardedIvfFlat,
-    queries, k: int,
+    queries, k: int, merge_engine: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Search the sharded index; returns replicated global-id results,
     identical to the single-device index built from the same centers.
@@ -201,7 +201,9 @@ def sharded_ivf_flat_search(
     there (k ≤ cells cap, per-list block within VMEM, TPU backend with
     enough probe load — or an explicit engine="bucketed"), so multi-chip
     search QPS tracks the single-chip production engine instead of the
-    per-query scan tier (VERDICT r4 Missing #1)."""
+    per-query scan tier (VERDICT r4 Missing #1). ``merge_engine``
+    selects the top-k merge collective (comms/topk_merge.py):
+    "allgather" | "ring" | "ring_bf16" | "auto"."""
     Q = _flat._as_float(_flat.as_array(queries))
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     n_probes = min(params.n_probes, index.centers.shape[0])
@@ -223,7 +225,9 @@ def sharded_ivf_flat_search(
         mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
         inner_is_l2=inner_is_l2, sqrt=sqrt, use_cells=use_cells,
         qrows=min(_flat._CELL_QROWS, max(8, Q.shape[0])),
-        interpret=jax.default_backend() != "tpu")
+        interpret=jax.default_backend() != "tpu",
+        engine=resolve_merge_engine(merge_engine, Q.shape[0], k,
+                                    mesh.shape[index.axis]))
 
 
 def sharded_ivf_pq_build(
@@ -291,19 +295,18 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes", "is_ip",
                               "pq_dim", "pq_bits", "sqrt", "qrows",
-                              "interpret"))
+                              "interpret", "engine"))
 def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
                                abs_lo, abs_hi, crot_p, Q, *, mesh, axis,
                                k, n_probes, is_ip, pq_dim, pq_bits, sqrt,
-                               qrows, interpret):
+                               qrows, interpret, engine):
     """Sharded compressed-domain search: each shard runs the PRODUCTION
     single-chip pipeline (``ivf_pq._compressed_search`` — packed query
     cells + the Pallas gather-decode MXU scan) over its own code shard,
-    then the per-shard top-k merge rides one all_gather (the
+    then the per-shard top-k merges inside the merge collective (the
     knn_merge_parts decomposition, brute_force.cuh:80; VERDICT r4
     Missing #1 — the sharded path previously ran the 139–254 QPS-class
     LUT scan tier)."""
-    n_dev = mesh.shape[axis]
 
     def body(codesT_l, inv_l, idx_l, centers_r, rot_r, lo_r, hi_r,
              crot_r, q):
@@ -313,14 +316,11 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
             q, centers_r, rot_r, codesT_l, lo_r, hi_r, inv_l, idx_l,
             crot_r, n_probes, kk, is_ip, pq_dim, pq_bits, qrows,
             interpret)
-        all_d = lax.all_gather(d, axis, axis=1, tiled=True)
-        all_i = lax.all_gather(i, axis, axis=1, tiled=True)
-        keys = all_d if is_ip else -all_d
-        _, pos = lax.top_k(keys, min(k, n_dev * d.shape[1]))
-        out_d = jnp.take_along_axis(all_d, pos, axis=1)
+        out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
+                                  engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
-        return out_d, jnp.take_along_axis(all_i, pos, axis=1)
+        return out_d, out_i
 
     fn = shard_map(
         body, mesh=mesh,
@@ -334,12 +334,11 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes", "is_ip",
                               "per_cluster", "pq_dim", "pq_bits", "sqrt",
-                              "lut_dtype", "internal_dtype"))
+                              "lut_dtype", "internal_dtype", "engine"))
 def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
                            mesh, axis, k, n_probes, is_ip, per_cluster,
                            pq_dim, pq_bits, sqrt, lut_dtype,
-                           internal_dtype=jnp.float32):
-    n_dev = mesh.shape[axis]
+                           internal_dtype=jnp.float32, engine="allgather"):
 
     def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q):
         codes_l, idx_l, sz_l = codes_l[0], idx_l[0], sz_l[0]
@@ -352,14 +351,11 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
             rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
             lut_dtype, pq_dim, pq_bits, internal_dtype,
             pq_centers=books_r, centers_rot=centers_rot)
-        all_d = lax.all_gather(d, axis, axis=1, tiled=True)
-        all_i = lax.all_gather(i, axis, axis=1, tiled=True)
-        keys = all_d if is_ip else -all_d
-        _, pos = lax.top_k(keys, min(k, n_dev * d.shape[1]))
-        out_d = jnp.take_along_axis(all_d, pos, axis=1)
+        out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
+                                  engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
-        return out_d, jnp.take_along_axis(all_i, pos, axis=1)
+        return out_d, out_i
 
     fn = shard_map(
         body, mesh=mesh,
@@ -370,7 +366,7 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
 
 def sharded_ivf_pq_search(
     mesh: Mesh, params: "_pq.SearchParams", index: ShardedIvfPq,
-    queries, k: int,
+    queries, k: int, merge_engine: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Search the sharded PQ index; returns replicated global-id results.
 
@@ -380,7 +376,8 @@ def sharded_ivf_pq_search(
     within the cells queue, per-list blocks within VMEM, TPU backend
     with enough probe load or explicit engine="bucketed"); otherwise
     the LUT scan tier runs per shard. Either way the per-shard top-k
-    merges over one all_gather."""
+    merges through the merge collective selected by ``merge_engine``
+    (comms/topk_merge.py)."""
     Q = _pq._as_float(_pq.as_array(queries))
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     lut_dtype, internal_dtype = _pq.validate_search_dtypes(params)
@@ -390,6 +387,8 @@ def sharded_ivf_pq_search(
     is_ip = index.metric == DistanceType.InnerProduct
     sqrt = index.metric == DistanceType.L2SqrtExpanded
 
+    engine = resolve_merge_engine(merge_engine, Q.shape[0], k,
+                                  mesh.shape[index.axis])
     n_lists = index.indices.shape[1]
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
@@ -409,14 +408,15 @@ def sharded_ivf_pq_search(
             is_ip=is_ip, pq_dim=index.pq_dim, pq_bits=index.pq_bits,
             sqrt=sqrt,
             qrows=min(_pq._CELL_QROWS, max(8, Q.shape[0])),
-            interpret=jax.default_backend() != "tpu")
+            interpret=jax.default_backend() != "tpu", engine=engine)
     return _sharded_pq_search_jit(
         index.pq_codes, index.indices, index.list_sizes, index.centers,
         index.rotation_matrix, index.pq_centers, Q,
         mesh=mesh, axis=index.axis, k=k, n_probes=n_probes, is_ip=is_ip,
         per_cluster=index.codebook_kind == _pq.CodebookGen.PER_CLUSTER,
         pq_dim=index.pq_dim, pq_bits=index.pq_bits,
-        sqrt=sqrt, lut_dtype=lut_dtype, internal_dtype=internal_dtype)
+        sqrt=sqrt, lut_dtype=lut_dtype, internal_dtype=internal_dtype,
+        engine=engine)
 
 
 # ---------------------------------------------------------------------------

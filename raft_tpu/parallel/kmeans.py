@@ -21,6 +21,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from raft_tpu.util.shard_map_compat import shard_map
 
+from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 
@@ -95,14 +96,18 @@ def sharded_kmeans_fit(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "n_iters", "n_clusters"))
+    jax.jit, static_argnames=("mesh", "axis", "n_iters", "n_clusters",
+                              "engine"))
 def _sharded_balanced_em_jit(X, centroids0, *, mesh, axis, n_iters,
-                             n_clusters):
+                             n_clusters, engine="allgather"):
     """Balancing EM entirely inside one jitted shard_map: assignment and
     sufficient statistics are local + psum (ref: balancing_em_iters,
     detail/kmeans_balanced.cuh:616, distributed per the kmeans-MG recipe);
-    the adjust_centers re-seed picks GLOBAL top-cost samples by
-    all-gathering each device's local top-n_clusters candidate rows."""
+    the adjust_centers re-seed picks GLOBAL top-cost samples with the
+    shared merge collective (comms/topk_merge.py) over (cost, global row
+    id), then fetches the winning rows from their owning shards with one
+    psum — n_clusters·dim of reduction traffic instead of all-gathering
+    every device's k·dim candidate rows."""
     n_dev = mesh.shape[axis]
 
     def body(X_local, c0):
@@ -123,16 +128,21 @@ def _sharded_balanced_em_jit(X, centroids0, *, mesh, axis, n_iters,
             new = sums / jnp.maximum(counts, 1.0)[:, None]
             new = jnp.where((counts > 0)[:, None], new, centroids)
 
-            # adjust_centers: global top-cost candidate rows = union of
-            # per-device top-n_clusters, re-ranked after an all_gather
-            # (k·n_dev rows of traffic, never the shards).
+            # adjust_centers: global top-cost rows via the shared merge
+            # collective — merge (cost, global row id) pairs, then one
+            # psum fetches each winning row from its owning shard (every
+            # global id lives on exactly one device).
             kk = min(n_clusters, n_local)
             top_d, top_i = lax.top_k(dists, kk)
-            cand_rows = X_local[top_i]                    # (kk, d)
-            all_d = lax.all_gather(top_d, axis, axis=0, tiled=True)
-            all_rows = lax.all_gather(cand_rows, axis, axis=0, tiled=True)
-            _, pos = lax.top_k(all_d, n_clusters)
-            seeds = all_rows[pos]                         # (k, d) global
+            gid = lax.axis_index(axis) * n_local + top_i
+            _, win = topk_merge(top_d[None], gid[None], n_clusters, axis,
+                                select_min=False, engine=engine)
+            win = win[0]                                  # (k,) global ids
+            rel = win - lax.axis_index(axis) * n_local
+            owned = (rel >= 0) & (rel < n_local)
+            rows = X_local[jnp.clip(rel, 0, n_local - 1)]
+            seeds = lax.psum(
+                jnp.where(owned[:, None], rows, 0.0), axis)  # (k, d)
 
             order = jnp.argsort(counts)
             rank = jnp.argsort(order)
@@ -150,6 +160,7 @@ def _sharded_balanced_em_jit(X, centroids0, *, mesh, axis, n_iters,
 
 def sharded_kmeans_balanced_fit(
     mesh: Mesh, X, n_clusters: int, n_iters: int = 20, axis: str = "data",
+    merge_engine: str = "auto",
 ) -> jax.Array:
     """Distributed balanced k-means over row-sharded data (ref:
     kmeans_balanced::fit distributed per the MNMG recipe,
@@ -167,5 +178,8 @@ def sharded_kmeans_balanced_fit(
             "rows must divide the mesh axis (pad first)")
     expects(n >= n_clusters, "need at least n_clusters rows")
     centroids0 = X[:: max(n // n_clusters, 1)][:n_clusters]
+    engine = resolve_merge_engine(merge_engine, 1, n_clusters,
+                                  mesh.shape[axis])
     return _sharded_balanced_em_jit(X, centroids0, mesh=mesh, axis=axis,
-                                    n_iters=n_iters, n_clusters=n_clusters)
+                                    n_iters=n_iters, n_clusters=n_clusters,
+                                    engine=engine)

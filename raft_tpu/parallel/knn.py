@@ -6,10 +6,11 @@ across ranks, searches each shard, and merges the per-rank top-k
 (docs/source/using_comms.rst:1-40; SURVEY.md §2.12 item 4).
 
 TPU-native: one ``shard_map`` over the mesh's data axis — each device scans
-its shard with the fused tiled kernel, then an ``all_gather`` over ICI
-brings the per-shard top-k (k ≪ shard) to every device and a final top-k
-merges. Communication volume is O(n_queries·k·n_devices), never the raw
-shards.
+its shard with the fused tiled kernel, then the per-shard top-k merges with
+the shared merge collective (comms/topk_merge.py): the pairwise k-selection
+runs *inside* the collective's ppermute steps, so communication is O(q·k)
+per step instead of an O(q·k·n_dev) allgather plus a replicated re-sort
+(``merge_engine`` selects allgather | ring | ring_bf16 | auto).
 """
 
 from __future__ import annotations
@@ -23,6 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.util.shard_map_compat import shard_map
 
+from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
 from raft_tpu.neighbors.brute_force import _tiled_knn_l2
 
@@ -35,12 +37,14 @@ def sharded_knn(
     axis: str = "data",
     sqrt: bool = False,
     tile_db: int = 8192,
+    merge_engine: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact L2 kNN with the database row-sharded over ``mesh[axis]``.
 
     ``db`` rows must be divisible by the axis size (pad upstream if not;
     static shapes). Returns replicated ``(distances (q,k), indices (q,k))``
-    with global row ids.
+    with global row ids. ``merge_engine`` picks the top-k merge collective
+    (see comms/topk_merge.py): "allgather", "ring", "ring_bf16" or "auto".
     """
     db = jnp.asarray(db)
     queries = jnp.asarray(queries)
@@ -50,28 +54,26 @@ def sharded_knn(
     shard = n // n_dev
     kk = min(k, shard)
     tile = min(tile_db, shard)
+    engine = resolve_merge_engine(merge_engine, queries.shape[0], k, n_dev)
     return _sharded_knn_jit(db, queries, mesh=mesh, axis=axis, k=k, kk=kk,
-                            sqrt=sqrt, tile=tile, shard=shard)
+                            sqrt=sqrt, tile=tile, shard=shard, engine=engine)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("mesh", "axis", "k", "kk", "sqrt", "tile", "shard"))
-def _sharded_knn_jit(db, queries, *, mesh, axis, k, kk, sqrt, tile, shard):
+    static_argnames=("mesh", "axis", "k", "kk", "sqrt", "tile", "shard",
+                     "engine"))
+def _sharded_knn_jit(db, queries, *, mesh, axis, k, kk, sqrt, tile, shard,
+                     engine):
     # jit around shard_map is load-bearing: an un-jitted shard_map runs in
     # the eager SPMD interpreter (~10x slower, measured on the CPU mesh).
-    n_dev = mesh.shape[axis]
 
     def local_search(db_local, q):
         # db_local: (shard, d) — this device's rows; q replicated.
         dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
         idx = idx + lax.axis_index(axis) * shard           # local → global ids
-        # Merge across devices: gather everyone's top-k, re-select.
-        all_d = lax.all_gather(dist, axis, axis=1, tiled=True)  # (q, n_dev*kk)
-        all_i = lax.all_gather(idx, axis, axis=1, tiled=True)
-        _, pos = lax.top_k(-all_d, min(k, n_dev * kk))
-        return (jnp.take_along_axis(all_d, pos, axis=1),
-                jnp.take_along_axis(all_i, pos, axis=1))
+        # Merge across devices inside the collective (topk_merge).
+        return topk_merge(dist, idx, k, axis, select_min=True, engine=engine)
 
     fn = shard_map(
         local_search, mesh=mesh,

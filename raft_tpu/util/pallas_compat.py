@@ -1,0 +1,16 @@
+"""Pallas TPU API names across jax versions.
+
+jax <= 0.4.x ships the Mosaic kernel options struct as
+``pltpu.TPUCompilerParams``; newer releases rename it
+``pltpu.CompilerParams``. Every raft_tpu kernel imports the alias from
+here so one spelling works under both.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as _pltpu
+
+TPUCompilerParams = getattr(_pltpu, "CompilerParams", None) or \
+    _pltpu.TPUCompilerParams
+
+__all__ = ["TPUCompilerParams"]

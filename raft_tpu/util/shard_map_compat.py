@@ -1,15 +1,21 @@
-"""shard_map across jax versions.
+"""shard_map and axis machinery across jax versions.
 
 jax >= 0.8 promotes ``shard_map`` to ``jax.shard_map`` and renames the
 replication-check flag ``check_rep`` → ``check_vma``; older versions ship it
 under ``jax.experimental.shard_map``. All raft_tpu call sites disable the
 check (collective-heavy bodies whose outputs are deliberately unreplicated),
 so this wrapper pins that behavior under whichever spelling exists.
+
+``lax.axis_size`` is similarly new; on older jax the static size of a bound
+axis comes from ``jax.core.axis_frame``. :func:`axis_size` covers both.
 """
 
 from __future__ import annotations
 
 import inspect
+
+import jax
+from jax import lax
 
 try:
     from jax import shard_map as _shard_map
@@ -27,3 +33,12 @@ _FLAG = ("check_vma"
 def shard_map(fn, mesh, in_specs, out_specs):
     return _shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                       **{_FLAG: False})
+
+
+def axis_size(axis) -> int:
+    """Static size of a bound shard_map axis, on any jax version."""
+    try:
+        return lax.axis_size(axis)
+    except AttributeError:  # jax <= 0.4: no lax.axis_size
+        frame = jax.core.axis_frame(axis)
+        return int(getattr(frame, "size", frame))

@@ -1,0 +1,296 @@
+"""Merge-collective tests: the ring / ring_bf16 engines must reproduce the
+allgather engine exactly on 1/2/4/8 simulated devices (conftest forces the
+8-virtual-CPU-device backend), including k > shard, distance ties, and the
+bf16 engine's exact-re-rank recall guard (ISSUE 1 tentpole)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_tpu.comms.topk_merge import (
+    MERGE_ENGINES, merge_comm_bytes, merge_parts, resolve_merge_engine,
+    topk_merge)
+from raft_tpu.util.shard_map_compat import shard_map
+
+
+def _mesh(n_dev):
+    devs = np.array(jax.devices())
+    assert devs.size >= 8, "conftest must force 8 virtual devices"
+    return Mesh(devs[:n_dev], ("data",))
+
+
+def _merge_on_mesh(mesh, dist, idx, k, select_min, engine):
+    """dist/idx: (n_dev, q, kk) host arrays — row d is device d's local
+    candidates; returns the replicated merged (distances, ids)."""
+    fn = shard_map(
+        lambda dd, ii: topk_merge(dd[0], ii[0], k, "data",
+                                  select_min=select_min, engine=engine),
+        mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P(None, None), P(None, None)))
+    d, i = jax.jit(fn)(jnp.asarray(dist), jnp.asarray(idx))
+    return np.asarray(d), np.asarray(i)
+
+
+def _host_truth(dist, idx, k, select_min):
+    """Host reference: global top-k under the shared (distance, id) order."""
+    n_dev, q, kk = dist.shape
+    flat_d = dist.transpose(1, 0, 2).reshape(q, n_dev * kk)
+    flat_i = idx.transpose(1, 0, 2).reshape(q, n_dev * kk)
+    keys = flat_d if select_min else -flat_d
+    order = np.lexsort((flat_i, keys), axis=1)[:, :min(k, n_dev * kk)]
+    return (np.take_along_axis(flat_d, order, 1),
+            np.take_along_axis(flat_i, order, 1))
+
+
+class TestEngineExactness:
+    # 3 and 6 exercise the non-power-of-two linear (store-and-forward)
+    # ring branch of _ring_merge; the pow2 sizes the log-step butterfly.
+    @pytest.mark.parametrize("n_dev", [1, 2, 3, 4, 6, 8])
+    @pytest.mark.parametrize("q,kk,k", [(4, 6, 5), (3, 2, 10), (1, 8, 8),
+                                        (7, 3, 64)])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_ring_matches_allgather(self, rng, n_dev, q, kk, k, select_min):
+        mesh = _mesh(n_dev)
+        dist = rng.normal(size=(n_dev, q, kk)).astype(np.float32)
+        idx = rng.permutation(n_dev * q * kk).astype(np.int32) \
+            .reshape(n_dev, q, kk)
+        base_d, base_i = _merge_on_mesh(mesh, dist, idx, k, select_min,
+                                        "allgather")
+        td, ti = _host_truth(dist, idx, k, select_min)
+        np.testing.assert_array_equal(base_d, td)
+        np.testing.assert_array_equal(base_i, ti)
+        for engine in ("ring", "ring_bf16", "auto"):
+            d, i = _merge_on_mesh(mesh, dist, idx, k, select_min, engine)
+            np.testing.assert_array_equal(base_d, d, err_msg=engine)
+            np.testing.assert_array_equal(base_i, i, err_msg=engine)
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 5, 7, 8])
+    def test_ties_resolve_identically(self, rng, n_dev):
+        """Mass distance ties: the shared lowest-id tie order must make
+        every engine (and every device of the butterfly) agree exactly."""
+        mesh = _mesh(n_dev)
+        q, kk, k = 5, 4, 9
+        dist = rng.integers(0, 3, size=(n_dev, q, kk)).astype(np.float32)
+        idx = rng.permutation(n_dev * q * kk).astype(np.int32) \
+            .reshape(n_dev, q, kk)
+        base = _merge_on_mesh(mesh, dist, idx, k, True, "allgather")
+        np.testing.assert_array_equal(
+            base[1], _host_truth(dist, idx, k, True)[1])
+        for engine in ("ring", "ring_bf16"):
+            d, i = _merge_on_mesh(mesh, dist, idx, k, True, engine)
+            np.testing.assert_array_equal(base[0], d, err_msg=engine)
+            np.testing.assert_array_equal(base[1], i, err_msg=engine)
+
+    def test_k_larger_than_total(self, rng):
+        """k beyond every shard's candidates: output clamps to n_dev*kk
+        (the sharded consumers' capacity contract)."""
+        mesh = _mesh(4)
+        dist = rng.normal(size=(4, 3, 2)).astype(np.float32)
+        idx = rng.permutation(24).astype(np.int32).reshape(4, 3, 2)
+        for engine in ("allgather", "ring", "ring_bf16"):
+            d, i = _merge_on_mesh(mesh, dist, idx, 50, True, engine)
+            assert d.shape == (3, 8) and i.shape == (3, 8)
+            np.testing.assert_array_equal(
+                np.sort(i, axis=1),
+                np.sort(idx.transpose(1, 0, 2).reshape(3, 8), axis=1))
+
+    def test_bf16_rerank_exact_distances(self, rng):
+        """The quantized engine must report EXACT f32 distances (the
+        re-rank recovers them from the owning shard) and full recall on
+        f32 data — recall@k == 1.0 vs the exact engine."""
+        mesh = _mesh(8)
+        q, kk, k = 16, 32, 10
+        dist = (rng.normal(size=(8, q, kk)) ** 2).astype(np.float32)
+        idx = rng.permutation(8 * q * kk).astype(np.int32).reshape(8, q, kk)
+        base_d, base_i = _merge_on_mesh(mesh, dist, idx, k, True,
+                                        "allgather")
+        d, i = _merge_on_mesh(mesh, dist, idx, k, True, "ring_bf16")
+        recall = np.mean([len(np.intersect1d(i[r], base_i[r])) / k
+                          for r in range(q)])
+        assert recall == 1.0
+        np.testing.assert_array_equal(base_d, d)   # exact after re-rank
+
+    def test_int64_ids(self, rng):
+        """ids stay exact at int64 under x64 (the quantized exchange only
+        touches distances)."""
+        if not jax.config.jax_enable_x64:
+            pytest.skip("x64 disabled in this suite config")
+        mesh = _mesh(4)
+        dist = rng.normal(size=(4, 3, 4)).astype(np.float32)
+        idx = rng.permutation(48).astype(np.int64).reshape(4, 3, 4)
+        base = _merge_on_mesh(mesh, dist, idx, 6, True, "allgather")
+        ring = _merge_on_mesh(mesh, dist, idx, 6, True, "ring")
+        assert ring[1].dtype == np.int64
+        np.testing.assert_array_equal(base[1], ring[1])
+
+
+class TestResolveAndBytes:
+    def test_resolve_rules(self):
+        assert resolve_merge_engine("ring", 1, 1, 8) == "ring"
+        assert resolve_merge_engine("auto", 100, 10, 1) == "allgather"
+        assert resolve_merge_engine("auto", 100, 10, 2) == "allgather"
+        assert resolve_merge_engine("auto", 100, 10, 8) == "ring"
+        # non-pow2: ring only at large merged volume
+        assert resolve_merge_engine("auto", 4, 10, 6) == "allgather"
+        assert resolve_merge_engine("auto", 4096, 128, 6) == "ring"
+        # quantized exchange is opt-in, never auto
+        for q, k, n in ((1, 1, 2), (10_000, 256, 64)):
+            assert resolve_merge_engine("auto", q, k, n) != "ring_bf16"
+        with pytest.raises(Exception):
+            resolve_merge_engine("bogus", 1, 1, 2)
+
+    def test_ring_bytes_below_allgather(self):
+        """The acceptance bar: ring moves fewer bytes at n_dev >= 4. The
+        bf16 engine pays a 2k guard margin + the exact-re-rank reduction,
+        so its crossover sits at n_dev >= 8."""
+        for n_dev in (4, 8, 16):
+            for q, k in ((32, 10), (1000, 100)):
+                ag = merge_comm_bytes("allgather", q, k, k, n_dev)
+                assert merge_comm_bytes("ring", q, k, k, n_dev) < ag, \
+                    (n_dev, q, k)
+                if n_dev >= 8:
+                    assert merge_comm_bytes("ring_bf16", q, k, k,
+                                            n_dev) < ag, (n_dev, q, k)
+        assert merge_comm_bytes("ring", 32, 10, 10, 1) == 0
+
+
+class TestShardedConsumers:
+    """The rewired sharded search paths give identical results per engine."""
+
+    @pytest.mark.parametrize("engine", ["ring", "ring_bf16"])
+    def test_sharded_knn_engines_agree(self, rng, engine):
+        from raft_tpu.parallel import sharded_knn
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        bd, bi = sharded_knn(mesh, db, q, k=10, merge_engine="allgather")
+        d, i = sharded_knn(mesh, db, q, k=10, merge_engine=engine)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_allclose(np.asarray(bd), np.asarray(d),
+                                   rtol=0, atol=0)
+
+    @pytest.mark.parametrize("engine", ["ring", "ring_bf16"])
+    def test_sharded_ivf_flat_engines_agree(self, rng, engine):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        q = rng.normal(size=(24, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        sp = ivf_flat.SearchParams(n_probes=8, engine="scan")
+        bd, bi = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                         merge_engine="allgather")
+        d, i = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                       merge_engine=engine)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_allclose(np.asarray(bd), np.asarray(d), atol=1e-6)
+
+    def test_sharded_ivf_pq_ring_agrees(self, rng):
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import (sharded_ivf_pq_build,
+                                       sharded_ivf_pq_search)
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(2048, 32)).astype(np.float32)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=4)
+        sharded = sharded_ivf_pq_build(mesh, params, db)
+        sp = ivf_pq.SearchParams(n_probes=8, engine="scan")
+        bd, bi = sharded_ivf_pq_search(mesh, sp, sharded, q, 10,
+                                       merge_engine="allgather")
+        d, i = sharded_ivf_pq_search(mesh, sp, sharded, q, 10,
+                                     merge_engine="ring")
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_allclose(np.asarray(bd), np.asarray(d), atol=1e-6)
+
+    def test_sharded_balanced_fit_ring_quality(self, rng):
+        """The reseed candidate merge through the collective keeps the
+        fit quality of the allgather-era path."""
+        from raft_tpu.parallel import sharded_kmeans_balanced_fit
+
+        mesh = _mesh(8)
+        X = rng.normal(size=(2048, 16)).astype(np.float32)
+        X[:1024] += 5.0
+        c_ring = sharded_kmeans_balanced_fit(mesh, X, 32, n_iters=8,
+                                             merge_engine="ring")
+        c_ag = sharded_kmeans_balanced_fit(mesh, X, 32, n_iters=8,
+                                           merge_engine="allgather")
+
+        def cost(c):
+            d = ((X[:, None, :] - np.asarray(c)[None]) ** 2).sum(-1)
+            return d.min(1).mean()
+
+        assert cost(c_ring) <= cost(c_ag) * 1.05
+
+
+def test_merge_parts_matches_concat_select(rng):
+    """The single-host pairwise-merge core reproduces concat+select_k
+    bit-for-bit (position tie order), odd part counts included."""
+    from raft_tpu.matrix.select_k import select_k
+
+    for n_parts in (1, 2, 3, 5):
+        keys = rng.random(size=(n_parts, 9, 4)).astype(np.float32)
+        vals = np.tile(np.arange(4, dtype=np.int32), (n_parts, 9, 1))
+        trans = list(range(0, 100 * n_parts, 100))
+        mk, mv = merge_parts(jnp.asarray(keys), jnp.asarray(vals),
+                             translations=trans)
+        flat_k = keys.transpose(1, 0, 2).reshape(9, -1)
+        flat_v = (np.array(trans)[:, None] + np.arange(4)) \
+            .reshape(-1)[None].repeat(9, 0)
+        ok, pos = select_k(jnp.asarray(flat_k), 4)
+        np.testing.assert_allclose(np.asarray(mk), np.asarray(ok))
+        np.testing.assert_array_equal(
+            np.asarray(mv), np.take_along_axis(flat_v, np.asarray(pos), 1))
+
+
+def test_merge_parts_unsigned_keys_select_max():
+    """Unsigned keys under select_min=False: negation wraps, so the key
+    mapping must go through iinfo.max - v (the select_k rule). Key 0 must
+    rank LAST, not first."""
+    keys = jnp.asarray(np.array([[[0, 5, 3]], [[7, 2, 0]]], np.uint32))
+    vals = jnp.asarray(np.array([[[10, 11, 12]], [[20, 21, 22]]], np.int32))
+    mk, mv = merge_parts(keys, vals, select_min=False)
+    np.testing.assert_array_equal(np.asarray(mk), [[7, 5, 3]])
+    np.testing.assert_array_equal(np.asarray(mv), [[20, 11, 12]])
+
+
+def test_comms_axis_size_inside_shard_map():
+    """Comms.get_size() without a bound mesh resolves the axis size via
+    the util shim on every jax version (lax.axis_size is new in 0.5)."""
+    from raft_tpu.comms import Comms
+
+    mesh = _mesh(4)
+    comms = Comms(axis="data")
+    fn = shard_map(lambda x: x[0] * comms.get_size(), mesh=mesh,
+                   in_specs=(P("data"),), out_specs=P(None))
+    out = jax.jit(fn)(jnp.ones((4, 2), jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out), np.full((2,), 4))
+
+
+def test_bench_sharded_family_smoke(capsys):
+    """Tier-1 multi-device smoke of the bench merge-engine family: one
+    tiny run must emit one JSON row per engine with qps + estimated
+    exchanged bytes, ring < allgather (ISSUE 1 bench/CI satellite)."""
+    import json
+
+    import bench as bench_pkg  # noqa: F401  (package import side effects)
+    from bench import sharded as bench_sharded
+
+    bench_sharded.run(quick=True)
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+            if l.strip()]
+    by_engine = {r["engine"]: r for r in rows if "engine" in r}
+    assert {"allgather", "ring", "ring_bf16"} <= set(by_engine)
+    for r in by_engine.values():
+        assert r["value"] > 0
+        assert r["est_exchange_bytes"] >= 0
+    n_dev = by_engine["ring"]["mesh_devices"]
+    if n_dev >= 4:
+        assert (by_engine["ring"]["est_exchange_bytes"]
+                < by_engine["allgather"]["est_exchange_bytes"])
