@@ -24,7 +24,10 @@ ROOT = Path(__file__).resolve().parent.parent
 SCAN = ["raft_tpu", "pylibraft", "raft_dask", "tests", "bench", "ci"]
 CITE_EXEMPT = {"__init__.py"}
 # Modules with no reference analog (pure environment shims).
-CITE_EXEMPT_REL = {"raft_tpu/util/shard_map_compat.py"}
+CITE_EXEMPT_REL = {
+    "raft_tpu/util/shard_map_compat.py",
+    "raft_tpu/util/pallas_compat.py",
+}
 
 
 def check_file(path: Path) -> list:

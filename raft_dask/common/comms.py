@@ -50,12 +50,22 @@ class Comms:
     def __init__(self, comms_p2p: bool = False, verbose: bool = False,
                  coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
-                 process_id: Optional[int] = None):
+                 process_id: Optional[int] = None,
+                 retry_policy=None):
         self.comms_p2p = comms_p2p
         self.verbose = verbose
         self._coord = coordinator_address
         self._nprocs = num_processes
         self._pid = process_id
+        # Bootstrap retry (raft_tpu.core.retry.RetryPolicy): the DCN
+        # coordinator rendezvous is the one genuinely flaky step of
+        # session formation — workers race the coordinator coming up, the
+        # exact window the reference's NCCL-unique-id broadcast retries
+        # through dask comms. None = DEFAULT_COMM_RETRY.
+        from raft_tpu.core.retry import DEFAULT_COMM_RETRY
+
+        self.retry_policy = (DEFAULT_COMM_RETRY if retry_policy is None
+                             else retry_policy)
         self.sessionId = uuid.uuid4().hex
         self.nccl_initialized = False  # name kept for API parity
         self.ucx_initialized = False
@@ -69,17 +79,37 @@ class Comms:
         """
         from raft_tpu.comms.comms import build_comms, inject_comms_on_handle
         from raft_tpu.core.resources import DeviceResources
+        from raft_tpu.core.retry import with_retry
 
         if self._coord is not None and not jax.distributed.is_initialized():
             # Multi-host bootstrap over DCN — the analog of the NCCL
             # unique-id broadcast (comms.py:135,355). The probe must not
             # touch the backend (jax.process_count() would initialize XLA
-            # and make the distributed init impossible).
-            jax.distributed.initialize(
-                coordinator_address=self._coord,
-                num_processes=self._nprocs,
-                process_id=self._pid,
-            )
+            # and make the distributed init impossible). Retried under
+            # the session policy: rendezvous races (coordinator not yet
+            # listening) surface as RuntimeError and succeed on
+            # re-attempt with deterministic backoff.
+
+            def bootstrap():
+                try:
+                    jax.distributed.initialize(
+                        coordinator_address=self._coord,
+                        num_processes=self._nprocs,
+                        process_id=self._pid,
+                    )
+                except Exception:
+                    # A failed connect leaves jax's distributed State
+                    # partially populated (client is assigned BEFORE
+                    # connect()); without this reset every re-attempt
+                    # would raise "initialize should only be called
+                    # once" instead of re-running the rendezvous.
+                    try:
+                        jax.distributed.shutdown()
+                    except Exception:
+                        pass
+                    raise
+
+            with_retry(bootstrap, self.retry_policy)
 
         devices = list(workers) if workers is not None else jax.devices()
         mesh = jax.sharding.Mesh(np.array(devices), (axis,))

@@ -208,9 +208,23 @@ def _lloyd(X, centroids0, sample_weight, max_iter: int, tol: float,
         it, centroids, _, _ = state
         labels, dists = min_cluster_and_distance(
             X, centroids, metric, bf16="split" if fast else None)
-        new, _ = update_centroids(
+        new, counts = update_centroids(
             X, labels, n_clusters, centroids_old=centroids, sample_weight=sample_weight
         )
+        # Reseed empty clusters at the current top-cost samples (ref: the
+        # empty-cluster handling of initRandom-seeded fits — detail/
+        # kmeans.cuh leaves them on their old centroid, which strands a
+        # random init that landed two seeds in one blob; the balanced
+        # variant's adjust_centers re-seeds from high-cost rows, the same
+        # policy applied here). Duplicate centroids resolve through the
+        # same path: argmin ties break to the lower index, starving the
+        # duplicate into emptiness, so it reseeds on the next sweep.
+        empty = counts == 0
+        cost = dists if sample_weight is None else dists * sample_weight
+        _, top_i = lax.top_k(cost, n_clusters)
+        seeds = X[top_i]                                   # (k, d) best-first
+        ord_ = jnp.clip(jnp.cumsum(empty) - 1, 0, n_clusters - 1)
+        new = jnp.where(empty[:, None], seeds[ord_], new)
         shift = jnp.sum((new - centroids) ** 2)
         inertia = jnp.sum(dists * (sample_weight if sample_weight is not None else 1.0))
         return it + 1, new, shift, inertia

@@ -9,6 +9,10 @@ from raft_tpu.comms.comms import (
     build_comms,
     inject_comms_on_handle,
 )
+from raft_tpu.comms.health import (
+    ShardHealth,
+    checked_sync,
+)
 from raft_tpu.comms.topk_merge import (
     MERGE_ENGINES,
     merge_comm_bytes,
@@ -32,7 +36,7 @@ from raft_tpu.comms.comms_test import (
 
 __all__ = [
     "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
-    "inject_comms_on_handle",
+    "inject_comms_on_handle", "ShardHealth", "checked_sync",
     "MERGE_ENGINES", "merge_comm_bytes", "merge_parts",
     "resolve_merge_engine", "topk_merge",
     "test_collective_allreduce", "test_collective_allreduce_prod",

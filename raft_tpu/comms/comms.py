@@ -237,7 +237,8 @@ class Comms:
         return lax.all_to_all(x, self.axis, split_axis=axis,
                               concat_axis=axis, tiled=True)
 
-    def host_sendrecv(self, x, dest: int, source: int):
+    def host_sendrecv(self, x, dest: int, source: int, retry=None,
+                      transfer_hook=None):
         """Paired HOST-buffer send/recv (ref: the host point-to-point
         role of comms_t::isend/irecv/waitall, core/comms.hpp:137-141 —
         UCX-tagged transfers between rank host buffers, e.g. raft-dask
@@ -250,8 +251,17 @@ class Comms:
         OUTSIDE shard_map bodies. One-sided *tagged* isend/irecv have no
         mesh analog (no rendezvous peer in a single-controller program);
         this paired form covers the transfer role — see docs/api_map.md.
+
+        ``retry``: optional :class:`raft_tpu.core.retry.RetryPolicy` —
+        this is an eager host transfer (stage → ppermute → fetch), the
+        kind of op that can transiently fail on a multi-host DCN and
+        succeed on re-attempt; a policy wraps the whole round-trip in
+        :func:`~raft_tpu.core.retry.with_retry` (deterministic backoff,
+        cause-chained re-raise). ``transfer_hook`` is a test seam (the
+        chaos harness wraps it) applied around one attempt's transfer.
         """
         from raft_tpu.core.error import expects
+        from raft_tpu.core.retry import with_retry
         from raft_tpu.util.shard_map_compat import shard_map as _sm
 
         expects(self.mesh is not None,
@@ -261,23 +271,30 @@ class Comms:
                 "leading axis must equal the comm size (one row per rank)")
         sharding = jax.sharding.NamedSharding(
             self.mesh, jax.sharding.PartitionSpec(self.axis))
-        # make_array_from_callback, not device_put: on a multi-process
-        # (jax.distributed) mesh each process can only place its own
-        # addressable shards.
-        xd = jax.make_array_from_callback(x.shape, sharding,
-                                          lambda idx: x[idx])
-        fn = jax.jit(_sm(
-            lambda v: self.device_sendrecv(v, dest, source),
-            mesh=self.mesh,
-            in_specs=jax.sharding.PartitionSpec(self.axis),
-            out_specs=jax.sharding.PartitionSpec(self.axis)))
-        out = fn(xd)
-        # Rows addressable to THIS process (all rows on a single-process
-        # mesh) — a process cannot read its peers' host buffers, same as
-        # the reference's per-rank recv buffers.
-        shards = sorted(out.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        return np.concatenate([np.asarray(s.data) for s in shards])
+
+        def transfer():
+            # make_array_from_callback, not device_put: on a multi-process
+            # (jax.distributed) mesh each process can only place its own
+            # addressable shards.
+            xd = jax.make_array_from_callback(x.shape, sharding,
+                                              lambda idx: x[idx])
+            fn = jax.jit(_sm(
+                lambda v: self.device_sendrecv(v, dest, source),
+                mesh=self.mesh,
+                in_specs=jax.sharding.PartitionSpec(self.axis),
+                out_specs=jax.sharding.PartitionSpec(self.axis)))
+            out = fn(xd)
+            # Rows addressable to THIS process (all rows on a single-
+            # process mesh) — a process cannot read its peers' host
+            # buffers, same as the reference's per-rank recv buffers.
+            shards = sorted(out.addressable_shards,
+                            key=lambda s: s.index[0].start or 0)
+            return np.concatenate([np.asarray(s.data) for s in shards])
+
+        op = transfer if transfer_hook is None else transfer_hook(transfer)
+        if retry is None:
+            return op()
+        return with_retry(op, retry)
 
 
 def build_comms(mesh: jax.sharding.Mesh, axis: str = "data") -> Comms:

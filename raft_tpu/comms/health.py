@@ -1,0 +1,133 @@
+"""Shard liveness registry for degraded-mode serving.
+
+Ref: the reference's comms layer surfaces async failures as status
+(``comms_t::sync_stream`` returning SUCCESS/ERROR/ABORT,
+cpp/include/raft/core/comms.hpp:135) but leaves "what now?" to callers.
+:class:`ShardHealth` is that missing policy object: a host-side per-rank
+liveness mask fed by sync_stream outcomes (or explicit ``mark_dead``)
+that the sharded search entry points consume as a ``live_mask`` — dead
+shards' candidates are neutralized to merge-padding sentinels and every
+query reports the ``coverage`` fraction of live database rows actually
+searched, so a serving layer chooses fail-hard vs serve-degraded
+(docs/fault_tolerance.md).
+
+The registry is deliberately eager/host-side state (plain numpy, no
+traced values): liveness changes between program launches, not inside a
+compiled step, exactly like the reference keeps its NCCL communicator
+status host-side.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+
+from raft_tpu.comms.comms import StatusT
+from raft_tpu.core.error import expects
+
+
+class ShardHealth:
+    """Per-rank liveness over one mesh axis.
+
+    A rank is LIVE until ``failure_threshold`` *consecutive* observed
+    failures (ERROR or ABORT from :meth:`record`) or an explicit
+    :meth:`mark_dead`. SUCCESS observations reset a live rank's failure
+    streak but never auto-revive a dead rank — a rank that went dead
+    stays dead until an operator (or a recovery path that re-validated
+    the shard, e.g. a reload) calls :meth:`mark_live`; flapping ranks
+    must not silently rejoin mid-serve with stale data.
+
+    Thread-safe: serving layers poke it from request threads while a
+    prober thread feeds sync_stream outcomes.
+    """
+
+    def __init__(self, n_ranks: int, failure_threshold: int = 1):
+        expects(n_ranks >= 1, "need at least one rank, got %s", n_ranks)
+        expects(failure_threshold >= 1,
+                "failure_threshold must be >= 1, got %s", failure_threshold)
+        self.n_ranks = n_ranks
+        self.failure_threshold = failure_threshold
+        self._lock = threading.Lock()
+        self._live = np.ones(n_ranks, dtype=bool)
+        self._streak = np.zeros(n_ranks, dtype=np.int64)
+
+    # -- feeds ------------------------------------------------------------
+    def record(self, rank: int, status: StatusT) -> bool:
+        """Feed one sync_stream outcome for ``rank``; returns the rank's
+        (possibly updated) liveness. ERROR and ABORT both count toward
+        the failure streak: ABORT is cooperative cancellation — the
+        shard's in-flight work is gone either way."""
+        self._check_rank(rank)
+        with self._lock:
+            if status == StatusT.SUCCESS:
+                if self._live[rank]:
+                    self._streak[rank] = 0
+                return bool(self._live[rank])
+            self._streak[rank] += 1
+            if self._streak[rank] >= self.failure_threshold:
+                self._live[rank] = False
+            return bool(self._live[rank])
+
+    def mark_dead(self, rank: int) -> None:
+        """Operator/chaos override: kill ``rank`` immediately."""
+        self._check_rank(rank)
+        with self._lock:
+            self._live[rank] = False
+            self._streak[rank] = self.failure_threshold
+
+    def mark_live(self, rank: int) -> None:
+        """Explicit revive (after the shard re-validated, e.g. reload)."""
+        self._check_rank(rank)
+        with self._lock:
+            self._live[rank] = True
+            self._streak[rank] = 0
+
+    # -- views ------------------------------------------------------------
+    @property
+    def live_mask(self) -> np.ndarray:
+        """Copy of the per-rank liveness mask (bool (n_ranks,)) — the
+        ``live_mask`` operand of the sharded search entry points."""
+        with self._lock:
+            return self._live.copy()
+
+    def is_live(self, rank: int) -> bool:
+        self._check_rank(rank)
+        with self._lock:
+            return bool(self._live[rank])
+
+    def n_live(self) -> int:
+        with self._lock:
+            return int(self._live.sum())
+
+    def coverage(self) -> float:
+        """Live fraction of ranks — the a-priori coverage bound when all
+        shards hold equal row counts (the per-query value the searches
+        report refines this by actually-probed rows)."""
+        with self._lock:
+            return float(self._live.sum()) / self.n_ranks
+
+    def all_live(self) -> bool:
+        with self._lock:
+            return bool(self._live.all())
+
+    def _check_rank(self, rank: int) -> None:
+        expects(0 <= rank < self.n_ranks,
+                "rank %s out of range [0, %s)", rank, self.n_ranks)
+
+    def __repr__(self) -> str:
+        return (f"ShardHealth(n_ranks={self.n_ranks}, "
+                f"live={self.live_mask.tolist()})")
+
+
+def checked_sync(comms, health: Optional[ShardHealth], rank: int,
+                 *arrays) -> StatusT:
+    """``sync_stream`` + health feed in one call: the idiom a host-side
+    driver loop uses after launching a sharded step —
+    ``status = checked_sync(comms, health, r, out)``. ``health=None``
+    degrades to a plain sync_stream."""
+    status = comms.sync_stream(*arrays)
+    if health is not None:
+        health.record(rank, status)
+    return status

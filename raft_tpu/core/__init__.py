@@ -12,6 +12,13 @@ from raft_tpu.core.error import (
     expects,
     fail,
 )
+from raft_tpu.core.retry import (
+    DEFAULT_COMM_RETRY,
+    DEFAULT_IO_RETRY,
+    RetryPolicy,
+    retrying,
+    with_retry,
+)
 from raft_tpu.core.mdarray import (
     MemoryType,
     ArraySpec,
@@ -48,6 +55,11 @@ __all__ = [
     "LogicError",
     "expects",
     "fail",
+    "RetryPolicy",
+    "with_retry",
+    "retrying",
+    "DEFAULT_IO_RETRY",
+    "DEFAULT_COMM_RETRY",
     "MemoryType",
     "ArraySpec",
     "check_matrix",

@@ -8,6 +8,8 @@ carry tracebacks natively so no explicit backtrace collection is needed.
 
 from __future__ import annotations
 
+from typing import NoReturn
+
 
 class RaftError(Exception):
     """Base exception for raft_tpu (ref: raft::exception, core/error.hpp:96)."""
@@ -22,16 +24,23 @@ class CudaError(RaftError):
     runtime errors (ref: raft::cuda_error, core/cudart_utils.hpp)."""
 
 
-def expects(cond: bool, msg: str = "precondition violated") -> None:
+def expects(cond: bool, msg: str = "precondition violated", *args) -> None:
     """Precondition check (ref: RAFT_EXPECTS, core/error.hpp:168).
 
     Raises :class:`LogicError` when ``cond`` is falsy.  Only usable on host
     (trace-time) values; inside jit use ``checkify``/``jax.debug`` instead.
+
+    Like ``RAFT_EXPECTS(cond, fmt, ...)`` the message is a lazy format:
+    ``expects(k > 0, "bad k=%s", k)`` pays the %-interpolation only on
+    failure (the hot-path call sites check trace-time invariants on every
+    dispatch, so eager f-strings would format on every success too).
     """
     if not cond:
-        raise LogicError(msg)
+        raise LogicError(msg % args if args else msg)
 
 
-def fail(msg: str) -> None:
-    """Unconditional failure (ref: RAFT_FAIL, core/error.hpp:188)."""
-    raise LogicError(msg)
+def fail(msg: str, *args) -> NoReturn:
+    """Unconditional failure (ref: RAFT_FAIL, core/error.hpp:188); lazy
+    %-formatting like :func:`expects`. Annotated ``NoReturn`` so type
+    checkers and readers see unreachable fallthrough."""
+    raise LogicError(msg % args if args else msg)

@@ -921,10 +921,17 @@ SERIALIZATION_VERSION = 3
 
 
 @traced
-def save(filename: str, index: Index) -> None:
-    """Ref: ivf_flat::serialize / pylibraft save (neighbors/ivf_flat.pyx)."""
-    np.savez(
-        filename,
+def save(filename: str, index: Index, retry=None) -> None:
+    """Ref: ivf_flat::serialize / pylibraft save (neighbors/ivf_flat.pyx).
+
+    The npz write runs under :func:`raft_tpu.core.retry.with_retry`
+    (``retry`` overrides :data:`~raft_tpu.core.retry.DEFAULT_IO_RETRY`):
+    index checkpoints land on network filesystems where transient
+    ``OSError`` blips are routine and a deterministic backoff re-attempt
+    is the correct response."""
+    from raft_tpu.core.retry import DEFAULT_IO_RETRY, with_retry
+
+    payload = dict(
         version=np.int64(SERIALIZATION_VERSION),
         metric=np.int64(index.metric.value),
         adaptive_centers=np.bool_(index.adaptive_centers),
@@ -934,27 +941,37 @@ def save(filename: str, index: Index) -> None:
         indices=np.asarray(index.indices),
         list_sizes=np.asarray(index.list_sizes),
     )
+    with_retry(lambda: np.savez(filename, **payload),
+               retry or DEFAULT_IO_RETRY)
 
 
 @traced
-def load(filename: str) -> Index:
-    """Ref: ivf_flat::deserialize / pylibraft load."""
+def load(filename: str, retry=None) -> Index:
+    """Ref: ivf_flat::deserialize / pylibraft load. IO retried like
+    :func:`save` (the np.load + array reads are one retriable unit)."""
+    from raft_tpu.core.retry import DEFAULT_IO_RETRY, with_retry
+
     if not filename.endswith(".npz"):
         filename = filename + ".npz"
-    with np.load(filename) as z:
-        version = int(z["version"])
-        expects(version == SERIALIZATION_VERSION,
-                f"serialization version mismatch: {version}")
-        # Guard the deserialize path the same way build() guards its
-        # idx_dtype knob: int64 ids without x64 enabled would otherwise be
-        # silently truncated to int32 by jnp.asarray.
-        validate_idx_dtype(z["indices"].dtype)
-        return Index(
-            metric=DistanceType(int(z["metric"])),
-            centers=jnp.asarray(z["centers"]),
-            data=jnp.asarray(z["data"]),
-            indices=jnp.asarray(z["indices"]),
-            list_sizes=jnp.asarray(z["list_sizes"]),
-            adaptive_centers=bool(z["adaptive_centers"]),
-            conservative_memory_allocation=bool(z["conservative"]),
-        )
+
+    def read():
+        with np.load(filename) as z:
+            return {k: z[k] for k in z.files}
+
+    z = with_retry(read, retry or DEFAULT_IO_RETRY)
+    version = int(z["version"])
+    expects(version == SERIALIZATION_VERSION,
+            "serialization version mismatch: %s", version)
+    # Guard the deserialize path the same way build() guards its
+    # idx_dtype knob: int64 ids without x64 enabled would otherwise be
+    # silently truncated to int32 by jnp.asarray.
+    validate_idx_dtype(z["indices"].dtype)
+    return Index(
+        metric=DistanceType(int(z["metric"])),
+        centers=jnp.asarray(z["centers"]),
+        data=jnp.asarray(z["data"]),
+        indices=jnp.asarray(z["indices"]),
+        list_sizes=jnp.asarray(z["list_sizes"]),
+        adaptive_centers=bool(z["adaptive_centers"]),
+        conservative_memory_allocation=bool(z["conservative"]),
+    )

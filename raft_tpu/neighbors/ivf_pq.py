@@ -660,19 +660,26 @@ def make_rotation_matrix(
 
 
 @functools.partial(jax.jit, static_argnums=(3, 4))
-def _vq_train_batched(key, data, weights, book_size: int, n_iters: int):
+def _vq_train_batched(key, data, weights, book_size: int, n_iters: int,
+                      init=None):
     """Train B codebooks at once: data (B, n, l), weights (B, n) — 0 weight
-    masks padded rows. Returns (B, book_size, l)."""
+    masks padded rows. Returns (B, book_size, l). ``init`` warm-starts the
+    EM from existing codebooks (B, book_size, l) — the OPQ alternation
+    refines the previous iteration's books instead of re-seeding, which is
+    what makes the rotation/codebook coordinate descent actually converge."""
     B, n, l = data.shape
 
-    # Init: strided samples (valid rows first — padded rows carry weight 0
-    # but a strided pick over the sorted-valid layout is good enough; the
-    # packing routine places valid rows first).
-    stride = max(n // book_size, 1)
-    centers0 = data[:, ::stride][:, :book_size]
-    if centers0.shape[1] < book_size:
-        reps = ceildiv(book_size, centers0.shape[1])
-        centers0 = jnp.tile(centers0, (1, reps, 1))[:, :book_size]
+    if init is not None:
+        centers0 = init
+    else:
+        # Init: strided samples (valid rows first — padded rows carry
+        # weight 0 but a strided pick over the sorted-valid layout is good
+        # enough; the packing routine places valid rows first).
+        stride = max(n // book_size, 1)
+        centers0 = data[:, ::stride][:, :book_size]
+        if centers0.shape[1] < book_size:
+            reps = ceildiv(book_size, centers0.shape[1])
+            centers0 = jnp.tile(centers0, (1, reps, 1))[:, :book_size]
 
     def em(_, centers):
         # (B, n, book) squared distances via batched matmul.
@@ -865,14 +872,21 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
         # The sub-trainset is an exact subsample of trainset, whose
         # labels are already computed above — no second assignment pass.
         xres = sub - centers[labels[::stride_o][:_OPQ_TRAIN_ROWS]]
+    books_it = None
     for _ in range(params.opq_iters):
         res = jnp.matmul(xres, rot.T, precision=lax.Precision.HIGHEST
                          ).reshape(-1, pq_dim, pq_len)
         data = jnp.swapaxes(res, 0, 1)
         w = jnp.ones(data.shape[:2], data.dtype)
+        # Warm-start each alternation from the previous books: OPQ is a
+        # coordinate descent on (rotation, codebooks) — re-seeding the VQ
+        # from scratch every iteration (the old behavior) discards the
+        # codebook coordinate's progress and the alternation stalls at
+        # ~1% MSE gain; refining the same books converges monotonically.
         books_it = _vq_train_batched(state.next_key(), data, w,
                                      book_size,
-                                     max(4, params.kmeans_n_iters // 2))
+                                     max(4, params.kmeans_n_iters // 2),
+                                     init=books_it)
         codes_it = _encode(res, books_it)
         # X̂ = quantized rotated residuals; Xres = unrotated residuals.
         cw = jnp.take_along_axis(
@@ -891,8 +905,14 @@ def build(params: IndexParams, dataset, handle=None) -> Index:
     if params.codebook_kind == CodebookGen.PER_SUBSPACE:
         data = jnp.swapaxes(res, 0, 1)                    # (pq_dim, nt, l)
         w = jnp.ones(data.shape[:2], data.dtype)
+        # After OPQ alternation the throwaway books are already fitted to
+        # (almost) this rotation's residual geometry — warm-starting the
+        # production training from them keeps the alternation's codebook
+        # progress instead of re-seeding and re-converging from scratch.
         pq_centers = _vq_train_batched(state.next_key(), data, w,
-                                       book_size, params.kmeans_n_iters)
+                                       book_size, params.kmeans_n_iters,
+                                       init=books_it if params.opq_iters > 0
+                                       else None)
     else:
         # PER_CLUSTER: pack each cluster's residual sub-vectors (over all
         # pq_dim positions, ref: train_per_cluster treats all sub-vectors of
@@ -1440,10 +1460,14 @@ SERIALIZATION_VERSION = 4
 
 
 @traced
-def save(filename: str, index: Index) -> None:
-    """Ref: ivf_pq::serialize / pylibraft save (ivf_pq.pyx:719)."""
-    np.savez(
-        filename,
+def save(filename: str, index: Index, retry=None) -> None:
+    """Ref: ivf_pq::serialize / pylibraft save (ivf_pq.pyx:719). The npz
+    write runs under :func:`raft_tpu.core.retry.with_retry` (``retry``
+    overrides :data:`~raft_tpu.core.retry.DEFAULT_IO_RETRY`) — same
+    transient-OSError contract as ivf_flat.save."""
+    from raft_tpu.core.retry import DEFAULT_IO_RETRY, with_retry
+
+    payload = dict(
         version=np.int64(SERIALIZATION_VERSION),
         metric=np.int64(index.metric.value),
         codebook_kind=np.int64(index.codebook_kind.value),
@@ -1457,32 +1481,42 @@ def save(filename: str, index: Index) -> None:
         indices=np.asarray(index.indices),
         list_sizes=np.asarray(index.list_sizes),
     )
+    with_retry(lambda: np.savez(filename, **payload),
+               retry or DEFAULT_IO_RETRY)
 
 
 @traced
-def load(filename: str) -> Index:
-    """Ref: ivf_pq::deserialize / pylibraft load (ivf_pq.pyx:765)."""
+def load(filename: str, retry=None) -> Index:
+    """Ref: ivf_pq::deserialize / pylibraft load (ivf_pq.pyx:765). IO
+    retried like :func:`save`."""
+    from raft_tpu.core.retry import DEFAULT_IO_RETRY, with_retry
+
     if not filename.endswith(".npz"):
         filename = filename + ".npz"
-    with np.load(filename) as z:
-        version = int(z["version"])
-        expects(version == SERIALIZATION_VERSION,
-                f"serialization version mismatch: {version}"
-                + (" (v3 unpacked-codes indexes predate the bit-packed "
-                   "layout; rebuild or re-save from a v3-era checkout)"
-                   if version == 3 else ""))
-        # int64 ids require x64 — otherwise jnp.asarray silently truncates.
-        validate_idx_dtype(z["indices"].dtype)
-        return Index(
-            metric=DistanceType(int(z["metric"])),
-            codebook_kind=CodebookGen(int(z["codebook_kind"])),
-            centers=jnp.asarray(z["centers"]),
-            rotation_matrix=jnp.asarray(z["rotation_matrix"]),
-            pq_centers=jnp.asarray(z["pq_centers"]),
-            pq_codes=jnp.asarray(z["pq_codes"]),
-            indices=jnp.asarray(z["indices"]),
-            list_sizes=jnp.asarray(z["list_sizes"]),
-            pq_bits=int(z["pq_bits"]),
-            pq_dim=int(z["pq_dim"]),
-            conservative_memory_allocation=bool(z["conservative"]),
-        )
+
+    def read():
+        with np.load(filename) as z:
+            return {k: z[k] for k in z.files}
+
+    z = with_retry(read, retry or DEFAULT_IO_RETRY)
+    version = int(z["version"])
+    expects(version == SERIALIZATION_VERSION,
+            f"serialization version mismatch: {version}"
+            + (" (v3 unpacked-codes indexes predate the bit-packed "
+               "layout; rebuild or re-save from a v3-era checkout)"
+               if version == 3 else ""))
+    # int64 ids require x64 — otherwise jnp.asarray silently truncates.
+    validate_idx_dtype(z["indices"].dtype)
+    return Index(
+        metric=DistanceType(int(z["metric"])),
+        codebook_kind=CodebookGen(int(z["codebook_kind"])),
+        centers=jnp.asarray(z["centers"]),
+        rotation_matrix=jnp.asarray(z["rotation_matrix"]),
+        pq_centers=jnp.asarray(z["pq_centers"]),
+        pq_codes=jnp.asarray(z["pq_codes"]),
+        indices=jnp.asarray(z["indices"]),
+        list_sizes=jnp.asarray(z["list_sizes"]),
+        pq_bits=int(z["pq_bits"]),
+        pq_dim=int(z["pq_dim"]),
+        conservative_memory_allocation=bool(z["conservative"]),
+    )

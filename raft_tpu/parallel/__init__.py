@@ -2,7 +2,11 @@
 (ref: the raft-dask + cuML MNMG pattern — shard data across ranks, combine
 with comms collectives, SURVEY.md §2.12 item 4)."""
 
-from raft_tpu.parallel.knn import sharded_knn
+from raft_tpu.parallel.knn import (
+    check_live_mask,
+    neutralize_dead,
+    sharded_knn,
+)
 from raft_tpu.parallel.kmeans import (
     sharded_kmeans_balanced_fit,
     sharded_kmeans_fit,
@@ -22,7 +26,8 @@ from raft_tpu.parallel.ivf import (
 )
 
 __all__ = [
-    "sharded_knn", "sharded_kmeans_fit", "sharded_kmeans_step",
+    "sharded_knn", "check_live_mask", "neutralize_dead",
+    "sharded_kmeans_fit", "sharded_kmeans_step",
     "sharded_kmeans_balanced_fit",
     "ShardedIvfFlat", "ShardedIvfPq",
     "sharded_ivf_flat_build", "sharded_ivf_flat_search",

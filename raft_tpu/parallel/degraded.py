@@ -1,0 +1,79 @@
+"""Degraded-mode serving helpers shared by every sharded search body.
+
+Ref: the reference's comms layer surfaces failures as status
+(``comms_t::sync_stream`` → SUCCESS/ERROR/ABORT, core/comms.hpp:135)
+and its ``knn_merge_parts`` (neighbors/brute_force.cuh:80) already
+ranks +inf/-1 padding last; these helpers compose the two into the
+degraded-serving contract (docs/fault_tolerance.md): a dead shard's
+candidates become merge padding, the merge returns the exact top-k
+over the survivors, and a per-query ``coverage`` fraction rides along.
+
+One module so the liveness plumbing — mask validation, the sentinel
+convention, the shard_map spec splice for the optional ``live``
+operand, and the probed-rows coverage reduction — has a single
+definition across ``parallel/knn.py`` and ``parallel/ivf.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from raft_tpu.core.error import expects
+
+
+def check_live_mask(live_mask, n_dev: int) -> jax.Array:
+    """Validate a per-shard liveness mask (host-side): bool (n_dev,),
+    at least one live shard (zero coverage cannot serve anything —
+    fail-hard there belongs to the caller's health policy, not inside a
+    compiled program). Shared by every sharded search entry point."""
+    live = np.asarray(live_mask)
+    expects(live.shape == (n_dev,),
+            "live_mask must be shape (%s,), got %s", n_dev, live.shape)
+    live = live.astype(bool)
+    expects(bool(live.any()), "all shards dead: nothing to search")
+    return jnp.asarray(live)
+
+
+def local_alive(live, axis):
+    """This shard's scalar liveness (traced bool) — call inside the
+    shard_map body."""
+    return live[lax.axis_index(axis)]
+
+
+def neutralize_dead(dist, idx, alive, select_min: bool):
+    """Replace a dead shard's candidates with the merge-padding sentinels
+    (worst-possible distance, id -1) so every merge engine ranks them
+    last — the ``merge_parts`` padding convention applied per shard.
+    ``alive`` is this shard's scalar liveness (see :func:`local_alive`)."""
+    worst = jnp.asarray(jnp.inf if select_min else -jnp.inf, dist.dtype)
+    return (jnp.where(alive, dist, worst),
+            jnp.where(alive, idx, jnp.asarray(-1, idx.dtype)))
+
+
+def live_specs(has_live: bool):
+    """The shard_map spec splice for the optional liveness operand:
+    ``(in_specs tail, out_specs tail)`` — the replicated (n_dev,) mask
+    in, the replicated per-query coverage out. Append both to the
+    body's base specs so all consumers stay structurally identical."""
+    return ((P(None),), (P(),)) if has_live else ((), ())
+
+
+def live_args(live):
+    """The matching call-site splice: ``fn(*base_args, *live_args(live))``."""
+    return () if live is None else (live,)
+
+
+def probed_coverage(probe_ids, sz_l, alive, axis):
+    """Per-query coverage: fraction of the probed candidate rows that
+    live on surviving shards. Every shard probes the same lists (the
+    coarse model is replicated), so the probed-row totals psum exactly
+    over the axis; dead shards' rows count in the denominator only —
+    the honest "how much of the answer set did we actually search"."""
+    local = jnp.sum(sz_l[probe_ids].astype(jnp.float32), axis=1)  # (q,)
+    total = lax.psum(local, axis)
+    live_total = lax.psum(jnp.where(alive, local, 0.0), axis)
+    return live_total / jnp.maximum(total, 1.0)

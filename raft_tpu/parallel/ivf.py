@@ -18,6 +18,11 @@ per-device top-k inside its ppermute steps — O(n_queries·k) per step
 themselves.
 Search results are identical to the single-device index built from the
 same model, because the probed candidate set is the same by construction.
+
+Both search entry points accept a ``live_mask`` for degraded-mode serving
+(docs/fault_tolerance.md): dead shards' candidates neutralize to the merge
+padding sentinels and a per-query ``coverage`` fraction (live probed rows /
+total probed rows) is returned alongside the results.
 """
 
 from __future__ import annotations
@@ -40,6 +45,14 @@ from raft_tpu.core.mdarray import validate_idx_dtype
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ivf_flat as _flat
 from raft_tpu.neighbors import ivf_pq as _pq
+from raft_tpu.parallel.degraded import (
+    check_live_mask,
+    live_args,
+    live_specs,
+    local_alive,
+    neutralize_dead,
+    probed_coverage,
+)
 from raft_tpu.util.pow2 import ceildiv, next_pow2
 from raft_tpu.util.shard_map_compat import shard_map
 
@@ -149,13 +162,16 @@ def sharded_ivf_flat_build(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes",
                               "inner_is_l2", "sqrt", "use_cells", "qrows",
                               "interpret", "engine"))
-def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
+def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None, *,
                              mesh, axis, k, n_probes, inner_is_l2, sqrt,
                              use_cells, qrows, interpret, engine):
     # jit around shard_map is load-bearing: un-jitted shard_map runs in the
     # eager SPMD interpreter (~10x slower, measured on the CPU mesh).
+    # ``live=None`` traces the pre-fault-tolerance two-output program —
+    # the all-live path stays bit-identical and pays nothing.
+    has_live = live is not None
 
-    def body(data_l, idx_l, sz_l, centers_r, q):
+    def body(data_l, idx_l, sz_l, centers_r, q, *rest):
         data_l, idx_l, sz_l = data_l[0], idx_l[0], sz_l[0]
         # Per-device top-k is bounded by this shard's slot capacity.
         kk = min(k, data_l.shape[0] * data_l.shape[1])
@@ -175,24 +191,36 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, *,
                      if inner_is_l2 else None)
             d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
                                      inner_is_l2, False, probe_ids=probe_ids)
+        if has_live:
+            alive = local_alive(rest[0], axis)
+            d, i = neutralize_dead(d, i, alive, inner_is_l2)
         # Merge the per-shard top-k inside the collective (topk_merge).
         out_d, out_i = topk_merge(d, i, k, axis, select_min=inner_is_l2,
                                   engine=engine)
         if inner_is_l2 and sqrt:
             out_d = jnp.sqrt(out_d)
-        return out_d, out_i
+        if not has_live:
+            return out_d, out_i
+        # Coverage over the probed lists (the cells engine probes the
+        # same coarse top-n_probes — the model is replicated, so one
+        # extra coarse scan reproduces its probe set exactly).
+        probe_ids = _flat._coarse_probe(q, centers_r, n_probes,
+                                        inner_is_l2)
+        cov = probed_coverage(probe_ids, sz_l, alive, axis)
+        return out_d, out_i, cov
 
+    extra_in, extra_out = live_specs(has_live)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(), P()),
-        out_specs=(P(), P()))
-    return fn(data, indices, sizes, centers, Q)
+        in_specs=(P(axis), P(axis), P(axis), P(), P()) + extra_in,
+        out_specs=(P(), P()) + extra_out)
+    return fn(data, indices, sizes, centers, Q, *live_args(live))
 
 
 def sharded_ivf_flat_search(
     mesh: Mesh, params: "_flat.SearchParams", index: ShardedIvfFlat,
-    queries, k: int, merge_engine: str = "auto",
-) -> Tuple[jax.Array, jax.Array]:
+    queries, k: int, merge_engine: str = "auto", live_mask=None,
+):
     """Search the sharded index; returns replicated global-id results,
     identical to the single-device index built from the same centers.
 
@@ -203,7 +231,15 @@ def sharded_ivf_flat_search(
     search QPS tracks the single-chip production engine instead of the
     per-query scan tier (VERDICT r4 Missing #1). ``merge_engine``
     selects the top-k merge collective (comms/topk_merge.py):
-    "allgather" | "ring" | "ring_bf16" | "auto"."""
+    "allgather" | "ring" | "ring_bf16" | "auto".
+
+    ``live_mask`` (bool (n_dev,), e.g. ``ShardHealth.live_mask``)
+    enables degraded serving (docs/fault_tolerance.md): dead shards'
+    candidates are neutralized before the merge, the result is exact
+    over the surviving shards' probed lists, and a third output
+    ``coverage`` (float32 (q,)) reports the per-query fraction of
+    probed candidate rows searched. All-live results are bit-identical
+    to the ``live_mask=None`` path."""
     Q = _flat._as_float(_flat.as_array(queries))
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     n_probes = min(params.n_probes, index.centers.shape[0])
@@ -220,9 +256,11 @@ def sharded_ivf_flat_search(
         params.engine, k, params.bucket_cap, index.indices.shape[2],
         index.centers.shape[1], Q.shape[0], n_probes,
         index.indices.shape[1])
+    live = (None if live_mask is None
+            else check_live_mask(live_mask, mesh.shape[index.axis]))
     return _sharded_flat_search_jit(
         index.data, index.indices, index.list_sizes, index.centers, Q,
-        mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
+        live, mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
         inner_is_l2=inner_is_l2, sqrt=sqrt, use_cells=use_cells,
         qrows=min(_flat._CELL_QROWS, max(8, Q.shape[0])),
         interpret=jax.default_backend() != "tpu",
@@ -297,9 +335,9 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
                               "pq_dim", "pq_bits", "sqrt", "qrows",
                               "interpret", "engine"))
 def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
-                               abs_lo, abs_hi, crot_p, Q, *, mesh, axis,
-                               k, n_probes, is_ip, pq_dim, pq_bits, sqrt,
-                               qrows, interpret, engine):
+                               abs_lo, abs_hi, crot_p, Q, live=None, *,
+                               mesh, axis, k, n_probes, is_ip, pq_dim,
+                               pq_bits, sqrt, qrows, interpret, engine):
     """Sharded compressed-domain search: each shard runs the PRODUCTION
     single-chip pipeline (``ivf_pq._compressed_search`` — packed query
     cells + the Pallas gather-decode MXU scan) over its own code shard,
@@ -307,40 +345,54 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
     knn_merge_parts decomposition, brute_force.cuh:80; VERDICT r4
     Missing #1 — the sharded path previously ran the 139–254 QPS-class
     LUT scan tier)."""
+    has_live = live is not None
 
     def body(codesT_l, inv_l, idx_l, centers_r, rot_r, lo_r, hi_r,
-             crot_r, q):
+             crot_r, q, *rest):
         codesT_l, inv_l, idx_l = codesT_l[0], inv_l[0], idx_l[0]
         kk = min(k, idx_l.shape[0] * idx_l.shape[1])
         d, i = _pq._compressed_search(
             q, centers_r, rot_r, codesT_l, lo_r, hi_r, inv_l, idx_l,
             crot_r, n_probes, kk, is_ip, pq_dim, pq_bits, qrows,
             interpret)
+        if has_live:
+            alive = local_alive(rest[0], axis)
+            d, i = neutralize_dead(d, i, alive, not is_ip)
         out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
                                   engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
-        return out_d, out_i
+        if not has_live:
+            return out_d, out_i
+        # Coverage over the probed lists: sizes recovered from the slot
+        # validity mask (sz = #valid slots per list); the probe set is
+        # the replicated coarse model's, reproduced exactly.
+        sz_l = jnp.sum((~inv_l).astype(jnp.int32), axis=1)
+        probe_ids = _pq._select_clusters((q, centers_r), n_probes, is_ip)
+        cov = probed_coverage(probe_ids, sz_l, alive, axis)
+        return out_d, out_i, cov
 
+    extra_in, extra_out = live_specs(has_live)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P(), P(),
-                  P()),
-        out_specs=(P(), P()))
+                  P()) + extra_in,
+        out_specs=(P(), P()) + extra_out)
     return fn(codesT, invalid, indices, centers, rot, abs_lo, abs_hi,
-              crot_p, Q)
+              crot_p, Q, *live_args(live))
 
 
 @functools.partial(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes", "is_ip",
                               "per_cluster", "pq_dim", "pq_bits", "sqrt",
                               "lut_dtype", "internal_dtype", "engine"))
-def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
-                           mesh, axis, k, n_probes, is_ip, per_cluster,
-                           pq_dim, pq_bits, sqrt, lut_dtype,
+def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q,
+                           live=None, *, mesh, axis, k, n_probes, is_ip,
+                           per_cluster, pq_dim, pq_bits, sqrt, lut_dtype,
                            internal_dtype=jnp.float32, engine="allgather"):
+    has_live = live is not None
 
-    def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q):
+    def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q, *rest):
         codes_l, idx_l, sz_l = codes_l[0], idx_l[0], sz_l[0]
         probe_ids = _pq._select_clusters((q, centers_r), n_probes, is_ip)
         rotq = jnp.matmul(q, rot_r.T, precision=lax.Precision.HIGHEST)
@@ -351,23 +403,32 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q, *,
             rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
             lut_dtype, pq_dim, pq_bits, internal_dtype,
             pq_centers=books_r, centers_rot=centers_rot)
+        if has_live:
+            alive = local_alive(rest[0], axis)
+            d, i = neutralize_dead(d, i, alive, not is_ip)
         out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
                                   engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
-        return out_d, out_i
+        if not has_live:
+            return out_d, out_i
+        cov = probed_coverage(probe_ids, sz_l, alive, axis)
+        return out_d, out_i, cov
 
+    extra_in, extra_out = live_specs(has_live)
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P()),
-        out_specs=(P(), P()))
-    return fn(codes, indices, sizes, centers, rot, books, Q)
+        in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P())
+        + extra_in,
+        out_specs=(P(), P()) + extra_out)
+    return fn(codes, indices, sizes, centers, rot, books, Q,
+              *live_args(live))
 
 
 def sharded_ivf_pq_search(
     mesh: Mesh, params: "_pq.SearchParams", index: ShardedIvfPq,
-    queries, k: int, merge_engine: str = "auto",
-) -> Tuple[jax.Array, jax.Array]:
+    queries, k: int, merge_engine: str = "auto", live_mask=None,
+):
     """Search the sharded PQ index; returns replicated global-id results.
 
     Engine dispatch mirrors the single-chip :func:`ivf_pq.search`: the
@@ -377,7 +438,13 @@ def sharded_ivf_pq_search(
     with enough probe load or explicit engine="bucketed"); otherwise
     the LUT scan tier runs per shard. Either way the per-shard top-k
     merges through the merge collective selected by ``merge_engine``
-    (comms/topk_merge.py)."""
+    (comms/topk_merge.py).
+
+    ``live_mask`` (bool (n_dev,), e.g. ``ShardHealth.live_mask``)
+    enables degraded serving on BOTH tiers (docs/fault_tolerance.md):
+    exact-over-survivors results plus a third ``coverage`` (float32
+    (q,)) output — the per-query fraction of probed candidate rows
+    searched. All-live results are bit-identical to ``live_mask=None``."""
     Q = _pq._as_float(_pq.as_array(queries))
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     lut_dtype, internal_dtype = _pq.validate_search_dtypes(params)
@@ -389,6 +456,8 @@ def sharded_ivf_pq_search(
 
     engine = resolve_merge_engine(merge_engine, Q.shape[0], k,
                                   mesh.shape[index.axis])
+    live = (None if live_mask is None
+            else check_live_mask(live_mask, mesh.shape[index.axis]))
     n_lists = index.indices.shape[1]
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
@@ -403,7 +472,7 @@ def sharded_ivf_pq_search(
             _sharded_scan_operands(mesh, index)
         return _sharded_pq_compressed_jit(
             codesT, invalid, index.indices, index.centers,
-            index.rotation_matrix, abs_lo, abs_hi, crot_p, Q,
+            index.rotation_matrix, abs_lo, abs_hi, crot_p, Q, live,
             mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
             is_ip=is_ip, pq_dim=index.pq_dim, pq_bits=index.pq_bits,
             sqrt=sqrt,
@@ -411,7 +480,7 @@ def sharded_ivf_pq_search(
             interpret=jax.default_backend() != "tpu", engine=engine)
     return _sharded_pq_search_jit(
         index.pq_codes, index.indices, index.list_sizes, index.centers,
-        index.rotation_matrix, index.pq_centers, Q,
+        index.rotation_matrix, index.pq_centers, Q, live,
         mesh=mesh, axis=index.axis, k=k, n_probes=n_probes, is_ip=is_ip,
         per_cluster=index.codebook_kind == _pq.CodebookGen.PER_CLUSTER,
         pq_dim=index.pq_dim, pq_bits=index.pq_bits,

@@ -11,22 +11,35 @@ the shared merge collective (comms/topk_merge.py): the pairwise k-selection
 runs *inside* the collective's ppermute steps, so communication is O(q·k)
 per step instead of an O(q·k·n_dev) allgather plus a replicated re-sort
 (``merge_engine`` selects allgather | ring | ring_bf16 | auto).
+
+Degraded-mode serving (docs/fault_tolerance.md): ``live_mask`` (typically
+``ShardHealth.live_mask``) neutralizes dead shards' candidates to the
+merge-padding sentinels (+inf distances / -1 ids — exactly what
+``topk_merge`` ranks last) so a lost host yields the exact top-k over the
+SURVIVING shards plus a per-query ``coverage`` fraction, never an
+exception.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
 from raft_tpu.neighbors.brute_force import _tiled_knn_l2
+from raft_tpu.parallel.degraded import (
+    check_live_mask,
+    live_args,
+    live_specs,
+    local_alive,
+    neutralize_dead,
+)
 
 
 def sharded_knn(
@@ -38,13 +51,22 @@ def sharded_knn(
     sqrt: bool = False,
     tile_db: int = 8192,
     merge_engine: str = "auto",
-) -> Tuple[jax.Array, jax.Array]:
+    live_mask=None,
+):
     """Exact L2 kNN with the database row-sharded over ``mesh[axis]``.
 
     ``db`` rows must be divisible by the axis size (pad upstream if not;
     static shapes). Returns replicated ``(distances (q,k), indices (q,k))``
     with global row ids. ``merge_engine`` picks the top-k merge collective
     (see comms/topk_merge.py): "allgather", "ring", "ring_bf16" or "auto".
+
+    ``live_mask`` (bool (n_dev,), e.g. ``ShardHealth.live_mask``) enables
+    degraded serving: dead shards contribute nothing, the result is the
+    exact top-k over the surviving shards' rows (tail slots pad with
+    +inf/-1 when k exceeds surviving capacity), and a third output
+    ``coverage`` (float32 (q,)) reports the fraction of database rows
+    searched per query. With every shard live the (distances, indices)
+    are bit-identical to the ``live_mask=None`` path.
     """
     db = jnp.asarray(db)
     queries = jnp.asarray(queries)
@@ -55,29 +77,47 @@ def sharded_knn(
     kk = min(k, shard)
     tile = min(tile_db, shard)
     engine = resolve_merge_engine(merge_engine, queries.shape[0], k, n_dev)
-    return _sharded_knn_jit(db, queries, mesh=mesh, axis=axis, k=k, kk=kk,
-                            sqrt=sqrt, tile=tile, shard=shard, engine=engine)
+    live = None if live_mask is None else check_live_mask(live_mask, n_dev)
+    return _sharded_knn_jit(db, queries, live, mesh=mesh, axis=axis, k=k,
+                            kk=kk, sqrt=sqrt, tile=tile, shard=shard,
+                            engine=engine)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "k", "kk", "sqrt", "tile", "shard",
                      "engine"))
-def _sharded_knn_jit(db, queries, *, mesh, axis, k, kk, sqrt, tile, shard,
-                     engine):
+def _sharded_knn_jit(db, queries, live, *, mesh, axis, k, kk, sqrt, tile,
+                     shard, engine):
     # jit around shard_map is load-bearing: an un-jitted shard_map runs in
     # the eager SPMD interpreter (~10x slower, measured on the CPU mesh).
+    # ``live=None`` traces the exact pre-fault-tolerance program (two
+    # outputs, no liveness operand) — the all-live fast path stays
+    # bit-identical and pays nothing.
+    has_live = live is not None
 
-    def local_search(db_local, q):
+    def local_search(db_local, q, *rest):
         # db_local: (shard, d) — this device's rows; q replicated.
         dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
         idx = idx + lax.axis_index(axis) * shard           # local → global ids
+        if has_live:
+            dist, idx = neutralize_dead(dist, idx,
+                                        local_alive(rest[0], axis), True)
         # Merge across devices inside the collective (topk_merge).
-        return topk_merge(dist, idx, k, axis, select_min=True, engine=engine)
+        out_d, out_i = topk_merge(dist, idx, k, axis, select_min=True,
+                                  engine=engine)
+        if not has_live:
+            return out_d, out_i
+        # Equal rows per shard → covered fraction is the live-shard
+        # fraction, reported per query (the IVF paths refine this by
+        # actually-probed rows).
+        cov = jnp.mean(rest[0].astype(jnp.float32))
+        return out_d, out_i, jnp.full((q.shape[0],), cov, jnp.float32)
 
+    extra_in, extra_out = live_specs(has_live)
     fn = shard_map(
         local_search, mesh=mesh,
-        in_specs=(P(axis, None), P(None, None)),
-        out_specs=(P(None, None), P(None, None)),
+        in_specs=(P(axis, None), P(None, None)) + extra_in,
+        out_specs=(P(None, None), P(None, None)) + extra_out,
     )
-    return fn(db, queries)
+    return fn(db, queries, *live_args(live))

@@ -1,0 +1,9 @@
+"""Testing utilities: the deterministic chaos/fault-injection harness."""
+
+from raft_tpu.testing.chaos import (
+    ChaosMonkey,
+    FaultSpec,
+    InjectedFault,
+)
+
+__all__ = ["ChaosMonkey", "FaultSpec", "InjectedFault"]

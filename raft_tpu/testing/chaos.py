@@ -1,0 +1,207 @@
+"""Deterministic, seeded fault injection for comms/IO call sites.
+
+Ref: the reference's comm layer is *designed* for async failure
+(``comms_t::sync_stream`` returns SUCCESS/ERROR/ABORT instead of
+throwing, cpp/include/raft/core/comms.hpp:135) but ships no way to
+*provoke* those failures in tests; its MNMG suites only exercise the
+happy path. This harness closes that gap for every robustness test in
+the repo: wrap an eager call site, script faults at exact call indexes,
+and the failure sequence replays bit-for-bit on every run — no
+wall-clock, no unseeded randomness.
+
+Three fault kinds (the failure modes of the sharded serving story):
+
+* ``"raise"``   — the call site raises :class:`InjectedFault` (or a
+  caller-supplied exception factory) — a lost transfer / IO error.
+* ``"corrupt"`` — the call runs, but its payload result is corrupted by
+  a seeded RNG (bit-flip-style additive noise on float arrays, value
+  scrambling on int arrays) — a torn read.
+* ``"drop_rank"`` — a scripted rank is marked dead in a
+  :class:`~raft_tpu.comms.health.ShardHealth` registry — a host loss,
+  feeding the degraded-serving path.
+
+Usage::
+
+    chaos = ChaosMonkey(seed=0)
+    flaky_save = chaos.wrap("save", ivf_flat.save,
+                            faults=[FaultSpec(kind="raise", at=(0, 1))])
+    with_retry(lambda: flaky_save(path, index),
+               RetryPolicy(max_attempts=3))
+    assert chaos.calls("save") == 3   # failed, failed, succeeded
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import RaftError, expects
+
+
+class InjectedFault(RaftError, OSError):
+    """A scripted fault from the chaos harness. Subclasses OSError so the
+    default IO retry policies (``retry_on=(OSError, ...)``) treat it as
+    transient without chaos-specific configuration."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One scripted fault: apply ``kind`` at the given 0-based call
+    indexes of a wrapped site.
+
+    ``rank`` names the victim for ``"drop_rank"``; ``error`` overrides
+    the raised exception factory for ``"raise"`` (a callable returning
+    an exception instance, so each attempt gets a fresh object and
+    retry cause-chains stay acyclic).
+    """
+
+    kind: str = "raise"                 # "raise" | "corrupt" | "drop_rank"
+    at: Tuple[int, ...] = (0,)
+    rank: int = -1
+    error: Optional[Callable[[], BaseException]] = None
+
+    def __post_init__(self):
+        expects(self.kind in ("raise", "corrupt", "drop_rank"),
+                "unknown fault kind %r", self.kind)
+        if self.kind == "drop_rank":
+            expects(self.rank >= 0, "drop_rank needs a victim rank")
+
+
+@dataclass
+class _Site:
+    faults: List[FaultSpec] = field(default_factory=list)
+    calls: int = 0
+
+
+class ChaosMonkey:
+    """Deterministic fault injector over named call sites.
+
+    Every wrapped site keeps its own call counter; faults fire when the
+    counter hits a scripted index. Corruption noise comes from one
+    ``np.random.default_rng(seed)`` stream consumed in call order, so a
+    given (seed, script, call sequence) reproduces the exact same
+    corrupted payloads every run.
+    """
+
+    def __init__(self, seed: int = 0, health=None):
+        # ``health``: an optional raft_tpu.comms.health.ShardHealth that
+        # "drop_rank" faults feed (kept untyped to avoid a hard import).
+        self.seed = seed
+        self.rng = np.random.default_rng(seed)
+        self.health = health
+        self._sites: Dict[str, _Site] = {}
+
+    # -- scripting --------------------------------------------------------
+    def script(self, site: str, faults: Sequence[FaultSpec]) -> None:
+        """Attach fault specs to ``site`` (extends any existing script)."""
+        self._sites.setdefault(site, _Site()).faults.extend(faults)
+
+    def wrap(self, site: str, fn: Callable,
+             faults: Optional[Sequence[FaultSpec]] = None) -> Callable:
+        """Wrap ``fn`` as chaos site ``site``; optionally script faults in
+        the same call. The wrapper consults the script before AND after
+        the real call: "raise" faults pre-empt the call (the transfer
+        never happened), "corrupt" faults mangle the returned payload,
+        "drop_rank" fires before the call (the host died under it)."""
+        if faults:
+            self.script(site, faults)
+        state = self._sites.setdefault(site, _Site())
+
+        @functools.wraps(fn)
+        def chaotic(*args, **kwargs):
+            idx = state.calls
+            state.calls += 1
+            fault = self._fault_at(state, idx)
+            if fault is not None and fault.kind == "drop_rank":
+                expects(self.health is not None,
+                        "drop_rank fault needs ChaosMonkey(health=...)")
+                self.health.mark_dead(fault.rank)
+                fault = None  # the call itself proceeds (degraded)
+            if fault is not None and fault.kind == "raise":
+                raise (fault.error() if fault.error is not None
+                       else InjectedFault(
+                           f"injected fault at {site}[{idx}]"))
+            out = fn(*args, **kwargs)
+            if fault is not None and fault.kind == "corrupt":
+                out = self.corrupt(out)
+            return out
+
+        return chaotic
+
+    def fire(self, site: str):
+        """Bare call-site hook for code that has no convenient callable to
+        wrap: bumps the site counter and raises/drops per the script.
+        Returns the 0-based call index it just consumed."""
+        state = self._sites.setdefault(site, _Site())
+        idx = state.calls
+        state.calls += 1
+        fault = self._fault_at(state, idx)
+        if fault is not None:
+            if fault.kind == "drop_rank":
+                expects(self.health is not None,
+                        "drop_rank fault needs ChaosMonkey(health=...)")
+                self.health.mark_dead(fault.rank)
+            elif fault.kind == "raise":
+                raise (fault.error() if fault.error is not None
+                       else InjectedFault(
+                           f"injected fault at {site}[{idx}]"))
+        return idx
+
+    # -- payload corruption ----------------------------------------------
+    def corrupt(self, payload):
+        """Deterministically mangle a payload (seeded stream, consumed in
+        call order). Floats get large additive noise on a random subset
+        of entries; ints get values scrambled to in-range garbage; pytrees
+        (tuple/list/dict) corrupt every array leaf."""
+        if isinstance(payload, tuple):
+            return tuple(self.corrupt(p) for p in payload)
+        if isinstance(payload, list):
+            return [self.corrupt(p) for p in payload]
+        if isinstance(payload, dict):
+            return {k: self.corrupt(v) for k, v in payload.items()}
+        arr = np.asarray(payload)
+        if arr.size == 0:
+            return payload
+        flat = np.array(arr, copy=True).reshape(-1)
+        n_hit = max(1, flat.size // 8)
+        hit = self.rng.choice(flat.size, size=n_hit, replace=False)
+        if np.issubdtype(flat.dtype, np.floating):
+            scale = np.abs(flat).max() + 1.0
+            flat[hit] += scale * (10.0 * self.rng.standard_normal(n_hit)
+                                  ).astype(flat.dtype)
+        elif np.issubdtype(flat.dtype, np.integer):
+            # Python ints: `flat.max() + 1` on a numpy scalar would wrap
+            # at the dtype max (the exclusive bound itself is in range
+            # for rng.integers).
+            lo, hi = int(flat.min()), int(flat.max()) + 1
+            flat[hit] = self.rng.integers(lo, max(hi, lo + 1), size=n_hit,
+                                          dtype=flat.dtype)
+        else:
+            return payload
+        return flat.reshape(arr.shape)
+
+    # -- introspection ----------------------------------------------------
+    def calls(self, site: str) -> int:
+        """How many times ``site`` has been entered."""
+        s = self._sites.get(site)
+        return 0 if s is None else s.calls
+
+    def reset(self, site: Optional[str] = None) -> None:
+        """Reset call counters (and the corruption RNG stream) so a
+        scripted scenario replays from the top."""
+        if site is None:
+            for s in self._sites.values():
+                s.calls = 0
+            self.rng = np.random.default_rng(self.seed)
+        else:
+            self._sites.setdefault(site, _Site()).calls = 0
+
+    @staticmethod
+    def _fault_at(state: _Site, idx: int) -> Optional[FaultSpec]:
+        for f in state.faults:
+            if idx in f.at:
+                return f
+        return None

@@ -1,0 +1,578 @@
+"""Fault-tolerance suite: retry/backoff, the deterministic chaos harness,
+shard liveness, and degraded-mode sharded search (the role of the
+reference's comms-failure contract — comms_t::sync_stream status codes,
+core/comms.hpp:135 — exercised end to end on the virtual CPU mesh)."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.comms import ShardHealth, StatusT, build_comms, checked_sync
+from raft_tpu.core.error import LogicError
+from raft_tpu.core.retry import (
+    AttemptTimeout,
+    DEFAULT_IO_RETRY,
+    RetryPolicy,
+    retrying,
+    with_retry,
+)
+from raft_tpu.testing import ChaosMonkey, FaultSpec, InjectedFault
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    """The acceptance grid's 4-device simulated mesh."""
+    devs = np.array(jax.devices())
+    assert devs.size >= 4, "conftest must force >= 4 virtual devices"
+    return Mesh(devs[:4], ("data",))
+
+
+class FakeClock:
+    """Deterministic sleep/monotonic pair: sleeps advance the clock and
+    are recorded, so backoff schedules are asserted exactly."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.sleeps = []
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.now += s
+
+    def monotonic(self):
+        return self.now
+
+
+class TestRetryPolicy:
+    def test_backoff_sequence_deterministic(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.1, backoff=2.0,
+                        max_delay=0.5)
+        assert p.delays() == (0.1, 0.2, 0.4, 0.5)
+        # pure function of the policy: same policy, same sequence
+        assert p.delays() == RetryPolicy(max_attempts=5, base_delay=0.1,
+                                         backoff=2.0,
+                                         max_delay=0.5).delays()
+
+    def test_policy_validation(self):
+        with pytest.raises(LogicError):
+            RetryPolicy(max_attempts=0)
+        with pytest.raises(LogicError):
+            RetryPolicy(backoff=0.5)
+
+    def test_fail_twice_then_succeed_in_exactly_three_attempts(self):
+        """The acceptance schedule: scripted to fail twice, the op
+        completes on attempt 3 having slept exactly the policy's first
+        two backoff delays."""
+        chaos = ChaosMonkey(seed=0)
+        calls = []
+        op = chaos.wrap("op", lambda: calls.append(1) or "ok",
+                        faults=[FaultSpec(kind="raise", at=(0, 1))])
+        clock = FakeClock()
+        policy = RetryPolicy(max_attempts=3, base_delay=0.05, backoff=2.0,
+                             retry_on=(InjectedFault,))
+        out = with_retry(op, policy, sleep=clock.sleep,
+                         monotonic=clock.monotonic)
+        assert out == "ok"
+        assert chaos.calls("op") == 3          # failed, failed, succeeded
+        assert len(calls) == 1                 # real op body ran once
+        assert tuple(clock.sleeps) == policy.delays()[:2] == (0.05, 0.1)
+
+    def test_exhaustion_raises_original_error_with_cause_chain(self):
+        chaos = ChaosMonkey(seed=0)
+        op = chaos.wrap("op", lambda: "never",
+                        faults=[FaultSpec(kind="raise", at=(0, 1, 2))])
+        clock = FakeClock()
+        policy = RetryPolicy(max_attempts=3, base_delay=0.01,
+                             retry_on=(InjectedFault,))
+        with pytest.raises(InjectedFault) as ei:
+            with_retry(op, policy, sleep=clock.sleep,
+                       monotonic=clock.monotonic)
+        # original error type, not a wrapper; attempt history chained
+        err = ei.value
+        assert "op[2]" in str(err)
+        assert isinstance(err.__cause__, InjectedFault)
+        assert "op[1]" in str(err.__cause__)
+        assert isinstance(err.__cause__.__cause__, InjectedFault)
+        assert "op[0]" in str(err.__cause__.__cause__)
+        assert err.__cause__.__cause__.__cause__ is None
+        assert chaos.calls("op") == 3
+        assert tuple(clock.sleeps) == policy.delays()
+
+    def test_non_retryable_error_propagates_immediately(self):
+        calls = []
+
+        def op():
+            calls.append(1)
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            with_retry(op, RetryPolicy(max_attempts=5,
+                                       retry_on=(OSError,)),
+                       sleep=lambda s: None)
+        assert len(calls) == 1
+
+    def test_attempt_timeout_is_retryable(self):
+        clock = FakeClock()
+        slow_then_fast = iter([10.0, 0.0])
+
+        def op():
+            clock.now += next(slow_then_fast)
+            return "done"
+
+        policy = RetryPolicy(max_attempts=2, base_delay=0.01,
+                             attempt_timeout=1.0, retry_on=())
+        out = with_retry(op, policy, sleep=clock.sleep,
+                         monotonic=clock.monotonic)
+        assert out == "done"
+        assert clock.sleeps == [0.01]          # one timeout, one retry
+
+    def test_on_retry_hook_sees_failed_attempts(self):
+        chaos = ChaosMonkey(seed=0)
+        op = chaos.wrap("op", lambda: "ok",
+                        faults=[FaultSpec(kind="raise", at=(0,))])
+        seen = []
+        with_retry(op, RetryPolicy(max_attempts=2, base_delay=0.0,
+                                   retry_on=(InjectedFault,)),
+                   on_retry=lambda a, e: seen.append((a, type(e))),
+                   sleep=lambda s: None)
+        assert seen == [(1, InjectedFault)]
+
+    def test_retrying_decorator(self):
+        chaos = ChaosMonkey(seed=0)
+        attempts = []
+
+        @retrying(RetryPolicy(max_attempts=2, base_delay=0.0,
+                              retry_on=(InjectedFault,)),
+                  sleep=lambda s: None)
+        def op(x):
+            attempts.append(x)
+            if len(attempts) == 1:
+                raise InjectedFault("first")
+            return x + 1
+
+        assert op(41) == 42
+        assert attempts == [41, 41]
+
+
+class TestChaosMonkey:
+    def test_corruption_is_seed_deterministic(self):
+        payload = np.arange(32, dtype=np.float32).reshape(4, 8)
+        a = ChaosMonkey(seed=7).corrupt(payload)
+        b = ChaosMonkey(seed=7).corrupt(payload)
+        c = ChaosMonkey(seed=8).corrupt(payload)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, c)
+        assert not np.array_equal(a, payload)      # actually corrupted
+        # original untouched (corrupt copies)
+        np.testing.assert_array_equal(payload,
+                                      np.arange(32,
+                                                dtype=np.float32
+                                                ).reshape(4, 8))
+
+    def test_corrupt_fault_kind_mangles_payload(self):
+        chaos = ChaosMonkey(seed=3)
+        op = chaos.wrap("load",
+                        lambda: np.ones(16, np.float32),
+                        faults=[FaultSpec(kind="corrupt", at=(1,))])
+        clean = op()
+        dirty = op()
+        np.testing.assert_array_equal(clean, np.ones(16, np.float32))
+        assert not np.array_equal(dirty, clean)
+
+    def test_int_corruption_stays_in_dtype(self):
+        ids = np.arange(64, dtype=np.int32)
+        out = ChaosMonkey(seed=1).corrupt(ids)
+        assert out.dtype == np.int32
+        assert not np.array_equal(out, ids)
+
+    def test_int_corruption_at_dtype_max_no_overflow(self):
+        """`max + 1` as the exclusive sampling bound must not wrap at
+        the dtype limit (numpy scalar add would)."""
+        import warnings
+
+        ids = np.array([0] * 63 + [np.iinfo(np.int32).max], np.int32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            out = ChaosMonkey(seed=1).corrupt(ids)
+        assert out.dtype == np.int32
+        assert not np.array_equal(out, ids)
+
+    def test_drop_rank_feeds_health(self):
+        health = ShardHealth(4)
+        chaos = ChaosMonkey(seed=0, health=health)
+        op = chaos.wrap("step", lambda: "ok",
+                        faults=[FaultSpec(kind="drop_rank", at=(2,),
+                                          rank=1)])
+        assert op() == op() == "ok"
+        assert health.all_live()
+        assert op() == "ok"                 # call 2: rank 1 dies under it
+        assert not health.is_live(1)
+        assert health.n_live() == 3
+
+    def test_scripted_replay_after_reset(self):
+        chaos = ChaosMonkey(seed=0)
+        op = chaos.wrap("op", lambda: "ok",
+                        faults=[FaultSpec(kind="raise", at=(0,))])
+        with pytest.raises(InjectedFault):
+            op()
+        assert op() == "ok"
+        chaos.reset("op")
+        with pytest.raises(InjectedFault):     # same script from the top
+            op()
+
+    def test_fire_site_hook(self):
+        chaos = ChaosMonkey(seed=0)
+        chaos.script("io", [FaultSpec(kind="raise", at=(1,))])
+        assert chaos.fire("io") == 0
+        with pytest.raises(InjectedFault):
+            chaos.fire("io")
+        assert chaos.fire("io") == 2
+
+
+class TestShardHealth:
+    def test_transitions_threshold(self):
+        h = ShardHealth(4, failure_threshold=2)
+        assert h.all_live() and h.coverage() == 1.0
+        assert h.record(2, StatusT.ERROR)      # one strike: still live
+        assert h.is_live(2)
+        assert not h.record(2, StatusT.ERROR)  # second strike: dead
+        assert not h.is_live(2)
+        assert h.n_live() == 3 and h.coverage() == 0.75
+
+    def test_success_resets_streak_but_never_revives(self):
+        h = ShardHealth(2, failure_threshold=2)
+        h.record(0, StatusT.ERROR)
+        h.record(0, StatusT.SUCCESS)           # streak reset
+        h.record(0, StatusT.ERROR)
+        assert h.is_live(0)                    # non-consecutive failures
+        h.record(0, StatusT.ERROR)
+        assert not h.is_live(0)
+        h.record(0, StatusT.SUCCESS)           # no silent rejoin
+        assert not h.is_live(0)
+        h.mark_live(0)                         # explicit revive only
+        assert h.is_live(0)
+
+    def test_abort_counts_as_failure(self):
+        h = ShardHealth(2)
+        h.record(1, StatusT.ABORT)
+        assert not h.is_live(1)
+
+    def test_mark_dead_immediate_and_mask(self):
+        h = ShardHealth(4)
+        h.mark_dead(3)
+        mask = h.live_mask
+        np.testing.assert_array_equal(mask, [True, True, True, False])
+        mask[0] = False                        # copy: registry unaffected
+        assert h.is_live(0)
+
+    def test_rank_bounds_checked(self):
+        h = ShardHealth(2)
+        with pytest.raises(LogicError):
+            h.mark_dead(2)
+        with pytest.raises(LogicError):
+            h.record(-1, StatusT.ERROR)
+
+    def test_checked_sync_feeds_registry(self, mesh4):
+        comms = build_comms(mesh4)
+        h = ShardHealth(4)
+        x = jax.numpy.ones((8,))
+        assert checked_sync(comms, h, 0, x) == StatusT.SUCCESS
+        assert h.is_live(0)
+        # a failing sync (cancelled future -> ABORT) records against its
+        # rank; interruptible_check clears the flag so later syncs are
+        # unaffected
+        from raft_tpu.core.interruptible import Interruptible
+
+        Interruptible.get_token().cancel()     # pre-cancel this thread
+        status = checked_sync(comms, h, 1, jax.numpy.ones((8,)))
+        assert status == StatusT.ABORT
+        assert not h.is_live(1)
+        assert checked_sync(comms, h, 0, jax.numpy.ones((4,))) \
+            == StatusT.SUCCESS
+
+
+class TestDegradedShardedSearch:
+    """Acceptance grid: one dead shard on the 4-device mesh — every merge
+    engine returns exactly the brute-force top-k over the survivors'
+    rows, coverage ≈ 3/4, and nothing raises; all-live results are
+    bit-identical to the live_mask=None path."""
+
+    K = 10
+    DEAD = 1
+
+    def _truth_over_survivors(self, db, q, mask, k):
+        dn = ((q[:, None, :] - db[None]) ** 2).sum(-1)
+        dn[:, ~mask] = np.inf
+        return np.sort(dn, axis=1)[:, :k], np.argsort(dn, axis=1,
+                                                      kind="stable")[:, :k]
+
+    @pytest.mark.parametrize("engine", ["allgather", "ring", "ring_bf16"])
+    def test_sharded_knn_exact_over_survivors(self, mesh4, rng, engine):
+        from raft_tpu.parallel import sharded_knn
+
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        shard = 1024 // 4
+        health = ShardHealth(4)
+        health.mark_dead(self.DEAD)
+
+        d0, i0 = sharded_knn(mesh4, db, q, k=self.K, merge_engine=engine)
+        d, i, cov = sharded_knn(mesh4, db, q, k=self.K,
+                                merge_engine=engine,
+                                live_mask=health.live_mask)
+        mask = np.ones(1024, bool)
+        mask[self.DEAD * shard:(self.DEAD + 1) * shard] = False
+        td, ti = self._truth_over_survivors(db, q, mask, self.K)
+        np.testing.assert_array_equal(np.sort(np.asarray(i), 1),
+                                      np.sort(ti, 1))
+        np.testing.assert_allclose(np.asarray(d), td, rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(np.asarray(cov), 0.75)
+        # no dead-shard ids leak through any engine
+        dead = set(range(self.DEAD * shard, (self.DEAD + 1) * shard))
+        assert not dead.intersection(np.asarray(i).ravel().tolist())
+
+        # all-live: bit-identical to the maskless path
+        da, ia, cova = sharded_knn(mesh4, db, q, k=self.K,
+                                   merge_engine=engine,
+                                   live_mask=np.ones(4, bool))
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(d0))
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(i0))
+        np.testing.assert_allclose(np.asarray(cova), 1.0)
+
+    def test_sharded_knn_k_exceeds_surviving_capacity(self, mesh4, rng):
+        """k > live rows: the tail pads with +inf/-1 and never raises."""
+        from raft_tpu.parallel import sharded_knn
+
+        db = rng.normal(size=(16, 4)).astype(np.float32)
+        q = rng.normal(size=(3, 4)).astype(np.float32)
+        live = np.array([True, False, False, False])
+        d, i, cov = sharded_knn(mesh4, db, q, k=8, live_mask=live)
+        d, i = np.asarray(d), np.asarray(i)
+        assert np.all(np.isinf(d[:, 4:])) and np.all(i[:, 4:] == -1)
+        assert np.all(np.isfinite(d[:, :4])) and np.all(i[:, :4] >= 0)
+        np.testing.assert_allclose(np.asarray(cov), 0.25)
+
+    def test_all_dead_fails_hard_on_host(self, mesh4, rng):
+        from raft_tpu.parallel import sharded_knn
+
+        db = rng.normal(size=(64, 4)).astype(np.float32)
+        q = rng.normal(size=(2, 4)).astype(np.float32)
+        with pytest.raises(LogicError):
+            sharded_knn(mesh4, db, q, k=4, live_mask=np.zeros(4, bool))
+        with pytest.raises(LogicError):
+            sharded_knn(mesh4, db, q, k=4, live_mask=np.ones(3, bool))
+
+    @pytest.mark.parametrize("engine", ["allgather", "ring", "ring_bf16"])
+    def test_sharded_ivf_flat_exact_over_survivors(self, mesh4, rng,
+                                                   engine):
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        q = rng.normal(size=(24, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        idx = sharded_ivf_flat_build(mesh4, params, db)
+        sp = ivf_flat.SearchParams(n_probes=16)   # all lists -> exact
+        live = np.ones(4, bool)
+        live[self.DEAD] = False
+
+        d0, i0 = sharded_ivf_flat_search(mesh4, sp, idx, q, self.K,
+                                         merge_engine=engine)
+        d, i, cov = sharded_ivf_flat_search(mesh4, sp, idx, q, self.K,
+                                            merge_engine=engine,
+                                            live_mask=live)
+        shard = 2048 // 4
+        mask = np.ones(2048, bool)
+        mask[self.DEAD * shard:(self.DEAD + 1) * shard] = False
+        td, ti = self._truth_over_survivors(db, q, mask, self.K)
+        np.testing.assert_array_equal(np.sort(np.asarray(i), 1),
+                                      np.sort(ti, 1))
+        np.testing.assert_allclose(np.asarray(d), td, rtol=1e-3,
+                                   atol=1e-3)
+        # every list probed and equal shard rows -> coverage exactly 3/4
+        np.testing.assert_allclose(np.asarray(cov), 0.75)
+
+        da, ia, cova = sharded_ivf_flat_search(mesh4, sp, idx, q, self.K,
+                                               merge_engine=engine,
+                                               live_mask=np.ones(4, bool))
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(d0))
+        np.testing.assert_allclose(np.asarray(cova), 1.0)
+
+    @pytest.mark.parametrize("pq_engine", ["scan", "bucketed"])
+    @pytest.mark.parametrize("engine", ["allgather", "ring", "ring_bf16"])
+    def test_sharded_ivf_pq_degraded(self, mesh4, rng, engine, pq_engine):
+        """PQ is lossy, so survivor-exactness is asserted in CODE space:
+        marking a shard dead must be indistinguishable from physically
+        emptying that shard's lists — same tier, same k, bit-identical
+        (distances, ids) — and coverage reports exactly 3/4 with every
+        list probed."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import (sharded_ivf_pq_build,
+                                       sharded_ivf_pq_search)
+
+        db = rng.normal(size=(2048, 32)).astype(np.float32)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                    kmeans_n_iters=4)
+        model = ivf_pq.build(
+            dataclasses.replace(params, add_data_on_build=False), db)
+        idx = sharded_ivf_pq_build(mesh4, params, db, model=model)
+        sp = ivf_pq.SearchParams(n_probes=16, engine=pq_engine)
+        live = np.ones(4, bool)
+        live[self.DEAD] = False
+        shard = 2048 // 4
+        dead = set(range(self.DEAD * shard, (self.DEAD + 1) * shard))
+
+        d0, i0 = sharded_ivf_pq_search(mesh4, sp, idx, q, self.K,
+                                       merge_engine=engine)
+        d, i, cov = sharded_ivf_pq_search(mesh4, sp, idx, q, self.K,
+                                          merge_engine=engine,
+                                          live_mask=live)
+        i = np.asarray(i)
+        assert not dead.intersection(i.ravel().tolist())
+
+        # The survivor reference: the same index with the dead shard's
+        # lists physically emptied (sizes 0, ids -1) — what a search
+        # over only the surviving data computes, on the same tier.
+        sizes = np.asarray(idx.list_sizes).copy()
+        sizes[self.DEAD] = 0
+        ids = np.asarray(idx.indices).copy()
+        ids[self.DEAD] = -1
+        emptied = dataclasses.replace(
+            idx, list_sizes=jnp.asarray(sizes), indices=jnp.asarray(ids),
+            _scan_cache=None)
+        dr, ir = sharded_ivf_pq_search(mesh4, sp, emptied, q, self.K,
+                                       merge_engine=engine)
+        np.testing.assert_array_equal(i, np.asarray(ir))
+        np.testing.assert_array_equal(np.asarray(d), np.asarray(dr))
+        np.testing.assert_allclose(np.asarray(cov), 0.75, atol=1e-6)
+
+        da, ia, cova = sharded_ivf_pq_search(mesh4, sp, idx, q, self.K,
+                                             merge_engine=engine,
+                                             live_mask=np.ones(4, bool))
+        np.testing.assert_array_equal(np.asarray(ia), np.asarray(i0))
+        np.testing.assert_array_equal(np.asarray(da), np.asarray(d0))
+        np.testing.assert_allclose(np.asarray(cova), 1.0)
+
+    def test_partial_probe_coverage_reflects_probed_rows(self, mesh4,
+                                                         rng):
+        """With n_probes < n_lists coverage is the probed-rows fraction,
+        not the shard fraction — per-query values vary with the query's
+        probe set but stay in (0, 1) and below the all-live 1.0."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        idx = sharded_ivf_flat_build(
+            mesh4, ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        live = np.array([True, False, True, True])
+        _, _, cov = sharded_ivf_flat_search(mesh4, sp, idx, q, 10,
+                                            live_mask=live)
+        cov = np.asarray(cov)
+        assert cov.shape == (32,)
+        assert np.all(cov > 0.0) and np.all(cov < 1.0)
+
+
+class TestRetriedCallSites:
+    """The wired call sites: host_sendrecv, save/load IO."""
+
+    def test_host_sendrecv_retries_through_chaos(self, mesh4):
+        from raft_tpu.comms import build_comms
+
+        comms = build_comms(mesh4)
+        x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+        want = comms.host_sendrecv(x, dest=1, source=0)
+
+        chaos = ChaosMonkey(seed=0)
+        chaos.script("sendrecv", [FaultSpec(kind="raise", at=(0, 1))])
+        out = comms.host_sendrecv(
+            x, dest=1, source=0,
+            retry=RetryPolicy(max_attempts=3, base_delay=0.0,
+                              retry_on=(InjectedFault,)),
+            transfer_hook=lambda fn: chaos.wrap("sendrecv", fn))
+        np.testing.assert_array_equal(out, want)
+        assert chaos.calls("sendrecv") == 3
+
+    def test_host_sendrecv_exhaustion_raises_original(self, mesh4):
+        from raft_tpu.comms import build_comms
+
+        comms = build_comms(mesh4)
+        x = np.zeros((4, 2), np.float32)
+        chaos = ChaosMonkey(seed=0)
+        chaos.script("sendrecv", [FaultSpec(kind="raise", at=(0, 1))])
+        with pytest.raises(InjectedFault):
+            comms.host_sendrecv(
+                x, dest=1, source=0,
+                retry=RetryPolicy(max_attempts=2, base_delay=0.0,
+                                  retry_on=(InjectedFault,)),
+                transfer_hook=lambda fn: chaos.wrap("sendrecv", fn))
+
+    def test_ivf_flat_save_load_retry_under_chaos(self, rng, tmp_path,
+                                                  monkeypatch):
+        from raft_tpu.neighbors import ivf_flat
+
+        db = rng.normal(size=(256, 8)).astype(np.float32)
+        idx = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=3), db)
+        path = str(tmp_path / "idx.npz")
+
+        chaos = ChaosMonkey(seed=0)
+        real_savez = np.savez
+        monkeypatch.setattr(
+            np, "savez",
+            chaos.wrap("savez", real_savez,
+                       faults=[FaultSpec(kind="raise", at=(0,))]))
+        ivf_flat.save(path, idx,
+                      retry=RetryPolicy(max_attempts=2, base_delay=0.0,
+                                        retry_on=(OSError,)))
+        assert chaos.calls("savez") == 2       # failed once, then wrote
+        monkeypatch.setattr(np, "savez", real_savez)
+
+        real_load = np.load
+        monkeypatch.setattr(
+            np, "load",
+            chaos.wrap("load", real_load,
+                       faults=[FaultSpec(kind="raise", at=(0,))]))
+        out = ivf_flat.load(path,
+                            retry=RetryPolicy(max_attempts=2,
+                                              base_delay=0.0,
+                                              retry_on=(OSError,)))
+        assert chaos.calls("load") == 2
+        monkeypatch.setattr(np, "load", real_load)
+        np.testing.assert_array_equal(np.asarray(out.indices),
+                                      np.asarray(idx.indices))
+
+    def test_ivf_pq_save_retry_exhaustion_keeps_oserror(self, rng,
+                                                        tmp_path,
+                                                        monkeypatch):
+        from raft_tpu.neighbors import ivf_pq
+
+        db = rng.normal(size=(256, 16)).astype(np.float32)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=4, pq_dim=8, kmeans_n_iters=3), db)
+        chaos = ChaosMonkey(seed=0)
+        monkeypatch.setattr(
+            np, "savez",
+            chaos.wrap("savez", np.savez,
+                       faults=[FaultSpec(kind="raise", at=(0, 1))]))
+        # InjectedFault IS an OSError: the default IO policy retries it
+        # and callers' except-OSError handlers still catch exhaustion.
+        with pytest.raises(OSError):
+            ivf_pq.save(str(tmp_path / "pq.npz"), idx,
+                        retry=RetryPolicy(max_attempts=2, base_delay=0.0,
+                                          retry_on=(OSError,)))
+        assert chaos.calls("savez") == 2
