@@ -567,6 +567,17 @@ def _family_10m():
           build_s=round(build_s, 1), spread_pct=round(spread, 1))
 
 
+def _family_serve():
+    """Online-serving runtime metrics (ISSUE 5): steady-state served QPS
+    per scheduler max_batch vs the per-request baseline, padded-slot
+    waste of the pow2 bucket grid, exact-query cache hit rate, and the
+    one-time warmup cost. Body lives in bench/serve.py (shared with the
+    tier-1 smoke test)."""
+    from bench.serve import run
+
+    run(quick=False)
+
+
 def _family_sharded():
     """Merge-engine metrics for the sharded search paths (ISSUE 1): QPS +
     estimated per-device exchange bytes per engine (allgather | ring |
@@ -675,6 +686,7 @@ def main():
     _run_family(_family, "bench_family_error")
     if "--no-1m" not in sys.argv:
         _run_family(_family_sharded, "bench_sharded_error")
+        _run_family(_family_serve, "bench_serve_error")
         _run_family(_family_1m, "bench_1m_error")
         _run_family(_family_sift1m_u8, "bench_sift1m_error")
         _run_family(_family_4m, "bench_4m_error")

@@ -1,0 +1,147 @@
+"""Serving-runtime bench family (ISSUE 5 bench satellite).
+
+Measures the online serving stack (raft_tpu/serve/) end to end on the
+full device mesh, bench.py-style one-JSON-row-per-metric:
+
+* ``serve_qps`` — steady-state served queries/s at several offered
+  batch-fill levels (closed loop: a synthetic mixed-size request stream
+  drives submit+pump as fast as the runtime completes), per scheduler
+  ``max_batch`` — the dynamic-batching win over per-request dispatch.
+* ``serve_per_request_qps`` — the same stream served one blocking call
+  per request (no scheduler), the baseline the micro-batcher beats.
+* ``serve_padded_waste_pct`` — padded-slot fraction of dispatched rows
+  (the pow2-bucket tax; bounded < 50% by construction).
+* ``serve_cache_hit_rate`` — hit rate on a stream with 30% repeated
+  queries (the trending/retry share of production traffic).
+* ``serve_warmup_s`` / ``serve_warmup_compiles`` — the boot cost the
+  bucket grid pays once so steady state pays zero.
+
+``quick=True`` is the CI smoke shape (tiny db, short stream, runs on
+the 8-virtual-CPU-device mesh in tier-1 via tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _request_stream(rng, n_requests, max_rows, dim, k_grid, repeat_frac):
+    """Synthetic production-ish stream: mixed sizes, mixed k, with a
+    ``repeat_frac`` share of exact repeats (the cacheable tail)."""
+    reqs = []
+    for _ in range(n_requests):
+        if reqs and rng.random() < repeat_frac:
+            reqs.append(reqs[rng.integers(0, len(reqs))])
+        else:
+            n = int(rng.integers(1, max_rows + 1))
+            k = int(k_grid[rng.integers(0, len(k_grid))])
+            reqs.append((rng.normal(size=(n, dim)).astype(np.float32), k))
+    return reqs
+
+
+def _drive(sched, reqs):
+    """Closed-loop saturation drive (offered load >= capacity): the whole
+    stream is queued, then drained — batches fill to max_batch, the
+    steady-state regime the QPS metric tracks. Returns (wall seconds,
+    total queries served)."""
+    t0 = time.perf_counter()
+    tickets = [sched.submit(q, k) for q, k in reqs]
+    sched.run_until_idle()
+    sec = time.perf_counter() - t0
+    assert all(t.done for t in tickets)
+    return sec, sum(q.shape[0] for q, _ in reqs)
+
+
+def run(quick: bool = False) -> None:
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.serve import (BatchPolicy, BatchScheduler, BucketGrid,
+                                ResultCache, Searcher, warmup)
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    rng = np.random.default_rng(5)
+
+    if quick:
+        n, d, n_requests = 1024, 16, 40
+        k_grid, max_rows = (5, 10), 8
+        batch_sizes = (16,)
+    else:
+        n, d, n_requests = 262_144, 128, 2000
+        k_grid, max_rows = (10, 100), 32
+        batch_sizes = (1, 16, 64)
+    n -= n % devs.size
+
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    searcher = Searcher.brute_force(db, mesh=mesh, merge_engine="auto")
+    grid = BucketGrid.pow2(max(batch_sizes), k_grid=k_grid)
+
+    t0 = time.perf_counter()
+    report = warmup(searcher, grid)
+    _emit("serve_warmup_s", time.perf_counter() - t0, "s",
+          shapes=report["shapes"], mesh_devices=devs.size)
+    _emit("serve_warmup_compiles", report["compile_events"], "programs",
+          shapes=report["shapes"])
+
+    reqs = _request_stream(rng, n_requests, max_rows, d, k_grid,
+                           repeat_frac=0.0)
+    # Baseline: one blocking search per request (what callers do today).
+    t0 = time.perf_counter()
+    for q, k in reqs:
+        searcher.search(q, k)
+    base_sec = time.perf_counter() - t0
+    n_rows = sum(q.shape[0] for q, _ in reqs)
+    _emit("serve_per_request_qps", n_rows / base_sec, "qps",
+          n_requests=len(reqs), mesh_devices=devs.size, n_db=n, dim=d)
+
+    for max_batch in batch_sizes:
+        sched = BatchScheduler(
+            searcher, grid,
+            BatchPolicy(max_batch=max_batch, max_wait=0.0,
+                        max_queue=max(64, 2 * n_requests)))
+        sec, rows = _drive(sched, reqs)
+        snap = sched.stats.snapshot()
+        padded = sum(b["padded_slots"] for b in snap["buckets"].values())
+        dispatched = sum(b["batched_rows"]
+                         for b in snap["buckets"].values())
+        _emit("serve_qps", rows / sec, "qps", max_batch=max_batch,
+              n_requests=len(reqs), mesh_devices=devs.size, n_db=n, dim=d)
+        _emit("serve_padded_waste_pct",
+              100.0 * padded / max(1, padded + dispatched), "%",
+              max_batch=max_batch)
+
+    # Cache-hit experiment: 30% repeated queries, driven OPEN-loop
+    # (flush per submit) so earlier answers are cached before their
+    # repeats arrive — the saturation drive would check every submit
+    # against a still-empty cache.
+    cached = BatchScheduler(
+        searcher, grid,
+        BatchPolicy(max_batch=max(batch_sizes), max_wait=0.0,
+                    max_queue=max(64, 2 * n_requests)),
+        cache=ResultCache(capacity=4096))
+    reqs_rep = _request_stream(rng, n_requests, max_rows, d, k_grid,
+                               repeat_frac=0.3)
+    tickets = []
+    for q, k in reqs_rep:
+        tickets.append(cached.submit(q, k))
+        cached.flush()
+    assert all(t.done for t in tickets)
+    _emit("serve_cache_hit_rate", cached.cache.snapshot()["hit_rate"],
+          "fraction", repeat_frac=0.3, n_requests=len(reqs_rep))
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

@@ -1,15 +1,20 @@
 #!/usr/bin/env bash
 # Python test gate (ref: ci/test_python.sh) — style first, then the suite.
 #
-# Two lanes:
+# Three lanes:
 #   * tier-1: everything except the chaos marker (the fast correctness
 #     gate — fault-injection stays out of its budget);
 #   * chaos:  the deterministic fault-injection lane
 #     (raft_tpu/testing/chaos.py harness; seeded, no wall-clock
 #     randomness, so a CI failure replays bit-for-bit locally with
-#     `pytest -m chaos`).
+#     `pytest -m chaos`);
+#   * serve:  fast re-run of the serving-runtime acceptance suite in
+#     isolation (injected clock + compile-counting hook; catches
+#     ordering dependencies the full-suite run can mask, e.g. a bucket
+#     shape another test happened to compile first).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 python ci/check_style.py
 python -m pytest tests/ -x -q -m "not chaos"
 python -m pytest tests/ -x -q -m "chaos"
+python -m pytest tests/test_serve.py -x -q

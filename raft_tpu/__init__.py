@@ -39,6 +39,10 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
               collectives (ref: cpp/include/raft/comms, raft/core/comms.hpp)
   parallel  — multi-device (MNMG-analog) algorithms: sharded kNN / kmeans
               (ref: raft-dask + cuML MNMG patterns)
+  serve     — online serving runtime above parallel/ and neighbors/:
+              shape-bucketed compilation, dynamic micro-batching
+              scheduler, exact-query result cache, deadline-aware
+              degraded serving (docs/serving.md)
   ops       — Pallas TPU kernels for the hot paths (select_k, fused L2 NN,
               PQ-LUT scan) (ref: hand-tiled CUDA kernels in detail/)
 """

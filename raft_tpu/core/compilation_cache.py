@@ -20,18 +20,27 @@ _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu", "xla")
 
 
 def enable_compilation_cache(path: Optional[str] = None) -> str:
-    """Turn on JAX's persistent compilation cache at ``path`` (default
-    ``~/.cache/raft_tpu/xla``, overridable via ``RAFT_TPU_XLA_CACHE``).
+    """Turn on JAX's persistent compilation cache; returns the EFFECTIVE
+    cache directory.
 
-    Safe to call repeatedly; returns the cache directory. Opt-in (a library
-    must not silently mutate global jax config) — ``bench.py`` and the test
-    harness call it, and applications should too.
+    Resolution order: an explicit ``path`` argument wins; otherwise a
+    ``jax_compilation_cache_dir`` the application already configured is
+    respected untouched (a library must not clobber deliberate global
+    jax config — serve-runtime warmup calls this on every boot);
+    otherwise ``RAFT_TPU_XLA_CACHE``; otherwise ``~/.cache/raft_tpu/xla``.
+    Safe to call repeatedly.
     """
     import jax
 
-    path = path or os.environ.get("RAFT_TPU_XLA_CACHE", _DEFAULT)
-    os.makedirs(path, exist_ok=True)
-    jax.config.update("jax_compilation_cache_dir", path)
+    preset = jax.config.jax_compilation_cache_dir
+    if path is None:
+        path = preset or os.environ.get("RAFT_TPU_XLA_CACHE") or _DEFAULT
+    if path != preset:
+        # Never makedirs a respected preset: it may be a non-local path
+        # (gs://...) that jax handles but makedirs would mangle, and by
+        # the app's contract it already exists.
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything non-trivial: raft_tpu's many small jitted engines
     # individually compile fast but number in the dozens per workload.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)

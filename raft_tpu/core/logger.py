@@ -10,6 +10,7 @@ reference's Python-callback sink used by pylibraft.
 from __future__ import annotations
 
 import logging
+import types
 from typing import Callable, Optional
 
 # Level names mirror the reference's RAFT_LEVEL_* (core/logger.hpp:40-57).
@@ -29,6 +30,20 @@ if not logger.handlers:
     _h.setFormatter(logging.Formatter("[%(levelname)s] [%(asctime)s] %(message)s"))
     logger.addHandler(_h)
     logger.setLevel(WARN)
+
+
+def _trace_method(self: logging.Logger, msg: str, *args, **kwargs) -> None:
+    """``logger.trace(...)`` convenience for the custom TRACE level (the
+    stdlib Logger only grows methods down to ``debug``; the reference's
+    RAFT_LOG_TRACE has no stdlib analog). Guarded by ``isEnabledFor`` so
+    per-batch serving hot paths pay one int compare when TRACE is off."""
+    if self.isEnabledFor(TRACE):
+        self._log(TRACE, msg, args, **kwargs)
+
+
+# Bound onto THIS logger instance only — patching logging.Logger would
+# leak raft_tpu conventions into every library in the process.
+logger.trace = types.MethodType(_trace_method, logger)
 
 
 class CallbackSink(logging.Handler):
@@ -69,4 +84,5 @@ def set_callback(
 
 
 def trace(msg: str, *args) -> None:
-    logger.log(TRACE, msg, *args)
+    """Module-level alias of :meth:`logger.trace`."""
+    logger.trace(msg, *args)

@@ -5,6 +5,7 @@ with comms collectives, SURVEY.md §2.12 item 4)."""
 from raft_tpu.parallel.knn import (
     check_live_mask,
     neutralize_dead,
+    shard_database,
     sharded_knn,
 )
 from raft_tpu.parallel.kmeans import (
@@ -26,7 +27,7 @@ from raft_tpu.parallel.ivf import (
 )
 
 __all__ = [
-    "sharded_knn", "check_live_mask", "neutralize_dead",
+    "sharded_knn", "shard_database", "check_live_mask", "neutralize_dead",
     "sharded_kmeans_fit", "sharded_kmeans_step",
     "sharded_kmeans_balanced_fit",
     "ShardedIvfFlat", "ShardedIvfPq",

@@ -68,6 +68,10 @@ class ShardedIvfFlat:
     indices: jax.Array      # (n_dev, n_lists, cap) global ids
     list_sizes: jax.Array   # (n_dev, n_lists) int32
     axis: str = "data"
+    # Monotonic content version, bumped by every extend — the serving
+    # layer's cache-invalidation key (serve/cache.py). Process-local:
+    # not serialized (a reload re-validates caches by construction).
+    epoch: int = 0
 
 
 @dataclass
@@ -86,6 +90,10 @@ class ShardedIvfPq:
     pq_bits: int = 8
     pq_dim: int = 0
     axis: str = "data"
+    # Monotonic content version, bumped by every extend — the serving
+    # layer's cache-invalidation key (serve/cache.py). Process-local:
+    # not serialized (a reload re-validates caches by construction).
+    epoch: int = 0
     # Lazy per-shard compressed-scan operands (transposed codes sharded
     # over the mesh axis + replicated absolute tables); rebuilt after
     # extend/load. Not serialized. See _sharded_scan_operands.
@@ -539,6 +547,7 @@ def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
     index.indices, index.list_sizes = id_, sz
     if hasattr(index, "_scan_cache"):
         index._scan_cache = None  # codes/occupancy changed
+    index.epoch += 1              # invalidates serving-layer result caches
     return index
 
 

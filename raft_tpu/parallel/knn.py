@@ -18,6 +18,10 @@ merge-padding sentinels (+inf distances / -1 ids — exactly what
 ``topk_merge`` ranks last) so a lost host yields the exact top-k over the
 SURVIVING shards plus a per-query ``coverage`` fraction, never an
 exception.
+
+Online serving (docs/serving.md): the serve runtime calls this entry
+point per micro-batch; :func:`shard_database` pre-places the database
+once so the hot path never re-transfers it.
 """
 
 from __future__ import annotations
@@ -27,7 +31,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
@@ -40,6 +44,22 @@ from raft_tpu.parallel.degraded import (
     local_alive,
     neutralize_dead,
 )
+
+
+def shard_database(mesh: Mesh, db, axis: str = "data") -> jax.Array:
+    """Pre-place database rows sharded over ``mesh[axis]`` (the layout
+    :func:`sharded_knn` consumes).
+
+    One-time placement for serving hot paths: the serve runtime
+    (``raft_tpu.serve``) calls :func:`sharded_knn` once per micro-batch,
+    and a host→device transfer of the database per request would dwarf
+    the search itself. Row count must divide the axis size (pad
+    upstream; same contract as :func:`sharded_knn`)."""
+    db = jnp.asarray(db)
+    expects(db.ndim == 2, "db must be (n, d), got %s", db.shape)
+    expects(db.shape[0] % mesh.shape[axis] == 0,
+            "db rows must divide the mesh axis (pad first)")
+    return jax.device_put(db, NamedSharding(mesh, P(axis, None)))
 
 
 def sharded_knn(

@@ -1,0 +1,34 @@
+"""Online query-serving runtime above ``parallel/`` and ``neighbors/``.
+
+The orchestration layer that turns the one-shot sharded search calls
+into a service: shape-bucketed compilation (``bucketing``), dynamic
+micro-batching with bounded-queue admission control and deadlines
+(``scheduler``), an exact-query LRU result cache keyed by index epoch
+(``cache``), a uniform searcher facade threading merge_engine /
+ShardHealth / RetryPolicy (``searcher``), and per-bucket serving stats
+(``stats``). See docs/serving.md.
+"""
+
+from raft_tpu.serve.bucketing import (
+    DEFAULT_K_GRID,
+    BucketGrid,
+    pad_queries,
+    warmup,
+)
+from raft_tpu.serve.cache import ResultCache
+from raft_tpu.serve.scheduler import (
+    BatchPolicy,
+    BatchScheduler,
+    Overloaded,
+    Ticket,
+)
+from raft_tpu.serve.searcher import Searcher, SearchResult
+from raft_tpu.serve.stats import CompileCounter, ServeStats
+
+__all__ = [
+    "BucketGrid", "DEFAULT_K_GRID", "pad_queries", "warmup",
+    "ResultCache",
+    "BatchPolicy", "BatchScheduler", "Overloaded", "Ticket",
+    "Searcher", "SearchResult",
+    "CompileCounter", "ServeStats",
+]

@@ -1,0 +1,359 @@
+"""Dynamic micro-batching query scheduler for online serving.
+
+Ref pattern: the reference batches only what one caller hands it — its
+MNMG search entry points are blocking one-shot calls over the comms
+layer (docs/source/using_comms.rst; our ``parallel/``). Production
+vector serving interposes the classic dynamic-batching tier (the
+TF-Serving / Triton BatchScheduler shape): requests of arbitrary size
+arrive asynchronously, a bounded queue absorbs bursts, and a
+max-batch-size / max-wait-time policy coalesces them into the few
+padded shapes the accelerator has compiled (serve/bucketing.py) —
+orchestration above the kernels, where fused-collective work
+(arXiv:2305.06942, HiCCL arXiv:2408.05962) shows the serving win lives.
+
+Disciplines:
+
+* **Injectable monotonic clock** — every timing decision (wait ripeness,
+  deadlines, latency stats) reads the injected clock, never wall time,
+  matching ``core/retry.py``; tests drive the scheduler tick by tick
+  and assert exact shed/flush behavior.
+* **Typed admission control** — a full queue sheds NEW work with
+  :class:`Overloaded` at submit time (clients can back off / hedge)
+  instead of letting latency collapse for everything already queued.
+* **Deadline-aware, degrade-don't-fail** — a request whose deadline is
+  at risk flushes its batch immediately rather than waiting for fill;
+  under dead shards the searcher serves exact-over-survivors results
+  with the PR-2 ``coverage`` fraction (docs/fault_tolerance.md), and a
+  missed deadline is a counter, never an exception.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu.core.error import RaftError, expects
+from raft_tpu.core.logger import logger
+from raft_tpu.serve.bucketing import BucketGrid, pad_queries
+from raft_tpu.serve.cache import ResultCache
+from raft_tpu.serve.searcher import SearchResult, Searcher
+from raft_tpu.serve.stats import ServeStats
+
+
+class Overloaded(RaftError):
+    """Admission control: the request queue is at ``max_queue`` — shed
+    this request now (the client backs off) instead of queueing into
+    certain deadline misses."""
+
+
+@dataclass(frozen=True)
+class BatchPolicy:
+    """When to stop waiting and dispatch.
+
+    A batch dispatches as soon as ANY of: its bucket holds
+    ``max_batch`` queued rows; its oldest request has waited
+    ``max_wait`` seconds; a member's deadline could not survive another
+    full wait. ``max_queue`` bounds queued REQUESTS — submit #max_queue+1
+    sheds with :class:`Overloaded`, deterministically.
+    """
+
+    max_batch: int = 64
+    max_wait: float = 0.002
+    max_queue: int = 1024
+
+    def __post_init__(self):
+        expects(self.max_batch >= 1, "max_batch must be >= 1")
+        expects(self.max_wait >= 0.0, "max_wait must be >= 0")
+        expects(self.max_queue >= 1, "max_queue must be >= 1")
+
+
+class Ticket:
+    """A submitted request's handle. The scheduler completes it from
+    :meth:`BatchScheduler.pump`; ``result()`` returns the
+    :class:`~raft_tpu.serve.searcher.SearchResult` (or re-raises the
+    serving error) once done."""
+
+    __slots__ = ("_result", "_error", "_done", "seq")
+
+    def __init__(self, seq: int):
+        self.seq = seq
+        self._result: Optional[SearchResult] = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def result(self) -> SearchResult:
+        expects(self._done, "request %s still queued — pump the scheduler",
+                self.seq)
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def _complete(self, result: SearchResult) -> None:
+        self._result, self._done = result, True
+
+    def _fail(self, err: BaseException) -> None:
+        self._error, self._done = err, True
+
+
+class _Pending:
+    __slots__ = ("queries", "k", "k_bucket", "deadline", "t_submit",
+                 "ticket")
+
+    def __init__(self, queries, k, k_bucket, deadline, t_submit, ticket):
+        self.queries = queries
+        self.k = k
+        self.k_bucket = k_bucket
+        self.deadline = deadline
+        self.t_submit = t_submit
+        self.ticket = ticket
+
+    @property
+    def rows(self) -> int:
+        return self.queries.shape[0]
+
+
+class BatchScheduler:
+    """Bounded-queue micro-batcher over one :class:`Searcher`.
+
+    Step-driven core: ``submit()`` enqueues (or answers from cache /
+    sheds), ``pump()`` runs one scheduling pass at the injected clock's
+    now. A driver loop (``run_until_idle`` for tests and batch jobs, or
+    a thread calling ``pump``) owns the cadence; the scheduler itself
+    never sleeps and never reads wall time. Queue admission and batch
+    selection are mutex-guarded, so request threads may submit while
+    one driver thread pumps — the ``max_queue`` bound stays exact; the
+    searcher call itself runs outside the lock.
+    """
+
+    def __init__(self, searcher: Searcher, grid: BucketGrid,
+                 policy: BatchPolicy = BatchPolicy(),
+                 cache: Optional[ResultCache] = None,
+                 stats: Optional[ServeStats] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        expects(policy.max_batch <= grid.max_batch,
+                "policy.max_batch=%s exceeds the bucket grid's largest "
+                "query bucket %s — full batches would compile out-of-grid "
+                "shapes", policy.max_batch, grid.max_batch)
+        self.searcher = searcher
+        self.grid = grid
+        self.policy = policy
+        self.cache = cache
+        self.stats = stats if stats is not None else ServeStats()
+        self._clock = clock
+        self._queue: List[_Pending] = []
+        self._lock = threading.Lock()
+        self._seq = itertools.count()
+        self._unhook = (searcher.add_invalidation_hook(cache.invalidate)
+                        if cache is not None else None)
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, queries, k: int,
+               deadline: Optional[float] = None) -> Ticket:
+        """Enqueue one request; returns its :class:`Ticket`.
+
+        ``deadline`` is an ABSOLUTE time on the scheduler's clock (e.g.
+        ``clock() + 0.05`` for a 50 ms budget). Cache hits complete the
+        ticket immediately without queueing. Raises :class:`Overloaded`
+        when ``max_queue`` requests are already pending; requests larger
+        than the query-bucket grid raise at submit (chunk client-side —
+        silently splitting would reorder against smaller requests).
+        """
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        expects(q.ndim == 2, "queries must be (n, dim), got %s", q.shape)
+        expects(q.shape[0] >= 1, "empty request")
+        expects(q.shape[0] <= self.grid.max_batch,
+                "request of %s rows exceeds the bucket grid (max %s): "
+                "chunk client-side", q.shape[0], self.grid.max_batch)
+        # Dim checked at admission, not dispatch: a bad request co-batched
+        # with good ones would otherwise fail the whole batch.
+        expects(q.shape[1] == self.searcher.dim,
+                "query dim %s != index dim %s", q.shape[1],
+                self.searcher.dim)
+        expects(k >= 1, "k must be >= 1, got %s", k)
+        now = self._clock()
+        ticket = Ticket(next(self._seq))
+        bucket = self.grid.bucket_for(q.shape[0], k) or (q.shape[0], k)
+
+        if self.cache is not None:
+            hit = self.cache.get(self.searcher.epoch, q, k)
+            if hit is not None:
+                self.stats.count(bucket, "requests")
+                self.stats.count(bucket, "cache_hits")
+                self.stats.observe_latency(bucket, 0.0)
+                ticket._complete(hit)
+                return ticket
+
+        kb = self.grid.bucket_k(k)
+        with self._lock:       # atomic bound check + append: the shed
+            pending = len(self._queue)      # point stays exact under
+            admitted = pending < self.policy.max_queue  # threaded submits
+            if admitted:
+                self._queue.append(_Pending(
+                    q, k, kb if kb is not None else k, deadline, now,
+                    ticket))
+        self.stats.count(bucket, "requests")
+        if not admitted:
+            self.stats.count(bucket, "shed")
+            raise Overloaded(
+                "queue full (%s pending >= max_queue=%s)"
+                % (pending, self.policy.max_queue))
+        if kb is None:  # out-of-grid k: served, but compiles its own shape
+            self.stats.count(bucket, "out_of_grid")
+        self.stats.count(bucket, "queued")
+        if self.cache is not None:
+            self.stats.count(bucket, "cache_misses")
+        return ticket
+
+    def pending(self) -> int:
+        return len(self._queue)
+
+    def now(self) -> float:
+        """The scheduler's clock (deadlines are absolute on THIS clock:
+        ``sched.submit(q, k, deadline=sched.now() + 0.05)``)."""
+        return self._clock()
+
+    # -- scheduling --------------------------------------------------------
+    def _ripe(self, group: List[_Pending], now: float) -> bool:
+        rows = sum(r.rows for r in group)
+        if rows >= self.policy.max_batch:
+            return True
+        oldest = min(r.t_submit for r in group)
+        if now - oldest >= self.policy.max_wait:
+            return True
+        # Deadline pressure: if waiting out the full window would push a
+        # member past its deadline, dispatch now (smaller batch, kept SLO).
+        return any(r.deadline is not None
+                   and r.deadline <= now + self.policy.max_wait
+                   for r in group)
+
+    def pump(self, force: bool = False) -> int:
+        """One scheduling pass at ``clock()``'s now: dispatch every ripe
+        k-bucket group (``force=True`` dispatches everything queued).
+        Returns the number of requests completed."""
+        now = self._clock()
+        plan: List[tuple] = []               # (batch, k_bucket, rows)
+        with self._lock:                     # select under the lock …
+            if not self._queue:
+                return 0
+            groups: Dict[int, List[_Pending]] = {}
+            for r in self._queue:
+                groups.setdefault(r.k_bucket, []).append(r)
+            # Oldest-first across groups: a ripe group with the oldest
+            # request dispatches before younger groups (FIFO fairness).
+            for kb in sorted(groups, key=lambda g: min(r.t_submit
+                                                       for r in groups[g])):
+                group = groups[kb]
+                start = 0                    # consumed prefix (FIFO)
+                while start < len(group) and (
+                        force or self._ripe(group[start:], now)):
+                    batch: List[_Pending] = []
+                    rows = 0
+                    while (start < len(group) and
+                           rows + group[start].rows <= self.policy.max_batch):
+                        batch.append(group[start])
+                        rows += group[start].rows
+                        start += 1
+                    if not batch:  # head larger than max_batch alone:
+                        batch = [group[start]]   # dispatch it solo anyway
+                        rows = batch[0].rows
+                        start += 1
+                    plan.append((batch, kb, rows))
+            dispatched = {id(r) for batch, _, _ in plan for r in batch}
+            # One O(n) rebuild instead of per-request list.remove.
+            self._queue = [r for r in self._queue
+                           if id(r) not in dispatched]
+        for batch, kb, rows in plan:         # … search outside the lock
+            self._dispatch(batch, kb, rows)
+        return sum(len(batch) for batch, _, _ in plan)
+
+    def flush(self) -> int:
+        """Dispatch everything queued regardless of ripeness (drain on
+        shutdown / end of test)."""
+        return self.pump(force=True)
+
+    def run_until_idle(self) -> int:
+        """Drain the queue completely; returns requests completed."""
+        total = 0
+        while self._queue:
+            total += self.flush()
+        return total
+
+    def close(self) -> None:
+        """Drain, then detach from the searcher (unregisters the cache
+        invalidation hook — a retired scheduler must not keep its cache
+        alive through the long-lived Searcher). Idempotent."""
+        self.run_until_idle()
+        if self._unhook is not None:
+            self._unhook()
+            self._unhook = None
+
+    # -- dispatch ----------------------------------------------------------
+    def _dispatch(self, batch: List[_Pending], kb: int, rows: int) -> None:
+        qb = self.grid.bucket_queries(rows) or rows
+        bucket = (qb, kb)
+        big = np.concatenate([r.queries for r in batch], axis=0)
+        padded = pad_queries(big, qb)
+        # Epoch captured BEFORE the search: an extend landing mid-search
+        # bumps it, and caching the pre-extend result under the new
+        # epoch would be a permanently-stale hit. Under the captured
+        # (old) epoch the entry is unreachable by construction.
+        epoch = self.searcher.epoch
+        try:
+            res = self.searcher.search(padded, kb)
+        except Exception as err:   # complete, never wedge the queue
+            now = self._clock()
+            for r in batch:
+                r.ticket._fail(err)
+                rbucket = (self.grid.bucket_for(r.rows, r.k)
+                           or (r.rows, r.k))
+                # Failures must show on the scrape surface, not only in
+                # a log line — an outage with healthy-looking stats is
+                # the worst observability failure mode.
+                self.stats.count(rbucket, "failed")
+                if r.deadline is not None and now > r.deadline:
+                    self.stats.count(rbucket, "deadline_misses")
+            logger.warning("serve batch %sx%s failed: %r", qb, kb, err)
+            return
+        now = self._clock()
+        # Batch-shape counters key on the DISPATCHED bucket; per-request
+        # counters below key on each request's own bucket, matching its
+        # submit-side rows (ServeStats docstring).
+        self.stats.count(bucket, "batches")
+        self.stats.count(bucket, "batched_requests", len(batch))
+        self.stats.count(bucket, "batched_rows", rows)
+        self.stats.count(bucket, "padded_slots", qb - rows)
+        row = 0
+        for r in batch:
+            sl = slice(row, row + r.rows)
+            # Copies, not views (ascontiguousarray would pass a
+            # contiguous slice through): a view pins the WHOLE padded
+            # batch buffer for as long as the cache or caller holds the
+            # result — up to (q_bucket·k_bucket)/(rows·k) amplification.
+            out = SearchResult(res.distances[sl, :r.k].copy(),
+                               res.indices[sl, :r.k].copy(),
+                               res.coverage[sl].copy(),
+                               degraded=res.degraded)
+            row += r.rows
+            if self.cache is not None and not res.degraded:
+                # Degraded (partial-coverage) answers are never cached:
+                # a hit after the shard recovers would replay the hole.
+                self.cache.put(epoch, r.queries, r.k, out)
+            rbucket = (self.grid.bucket_for(r.rows, r.k)
+                       or (r.rows, r.k))
+            if res.degraded:
+                self.stats.count(rbucket, "degraded_responses")
+            if r.deadline is not None and now > r.deadline:
+                self.stats.count(rbucket, "deadline_misses")
+            self.stats.observe_latency(rbucket, now - r.t_submit)
+            r.ticket._complete(out)
+        logger.trace("serve batch %sx%s: %s requests, %s rows, %s padded",
+                     qb, kb, len(batch), rows, qb - rows)

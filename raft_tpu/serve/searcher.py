@@ -1,0 +1,275 @@
+"""Uniform search facade for the serving runtime.
+
+Ref pattern: the reference exposes each index family as free functions
+(brute_force::knn, ivf_flat::search, ivf_pq::search,
+neighbors/brute_force.cuh / ivf_flat.cuh / ivf_pq.cuh) and leaves
+composition to the application; the MNMG recipe adds per-rank shards
+merged with knn_merge_parts (docs/source/using_comms.rst). The serving
+runtime needs one object that hides which family and which deployment
+(single-host vs sharded mesh) sits underneath, because the scheduler
+(serve/scheduler.py) batches requests against an opaque ``search(q, k)``.
+
+:class:`Searcher` is that facade. It threads through everything the
+fault-tolerance and collective layers already provide:
+
+* ``merge_engine`` — the top-k merge collective knob
+  (comms/topk_merge.py) on every sharded call;
+* ``ShardHealth`` — when any rank is dead, searches pass
+  ``health.live_mask`` and serve DEGRADED (exact over survivors, never
+  an exception), returning the per-query ``coverage`` fraction
+  (docs/fault_tolerance.md);
+* ``RetryPolicy`` — transient host-side failures retry with the
+  deterministic backoff of ``core/retry.py``;
+* ``epoch`` — the cache-invalidation key (serve/cache.py): bumped by
+  every extend, so cached results can never outlive the index state
+  they were computed against.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.retry import RetryPolicy, with_retry
+
+_KINDS = ("brute_force", "ivf_flat", "ivf_pq")
+
+
+@dataclass(frozen=True)
+class SearchResult:
+    """One request's answer: replicated host arrays.
+
+    ``coverage`` is all-ones on healthy serves; under degraded serving
+    it is the PR-2 per-query fraction of candidate rows actually
+    searched (docs/fault_tolerance.md). ``degraded`` flags that a
+    live_mask was applied.
+    """
+
+    distances: np.ndarray   # (n_queries, k)
+    indices: np.ndarray     # (n_queries, k)
+    coverage: np.ndarray    # (n_queries,)
+    degraded: bool = False
+
+
+class Searcher:
+    """One serving endpoint over a brute-force / IVF-Flat / IVF-PQ index,
+    single-host or sharded over a mesh. Build with the classmethods:
+
+    >>> s = Searcher.brute_force(db, mesh=mesh, health=health)   # doctest: +SKIP
+    >>> s = Searcher.ivf_flat(index, sp, mesh=mesh)              # doctest: +SKIP
+    >>> res = s.search(queries, k=10)                            # doctest: +SKIP
+    """
+
+    def __init__(self, kind: str, *, mesh=None, db=None, index=None,
+                 search_params=None, merge_engine: str = "auto",
+                 health=None, retry: Optional[RetryPolicy] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 monotonic: Callable[[], float] = time.monotonic):
+        expects(kind in _KINDS, "kind must be one of %s, got %r", _KINDS,
+                kind)
+        expects((db is not None) == (kind == "brute_force"),
+                "brute_force takes db; IVF kinds take index")
+        if kind != "brute_force":
+            expects(index is not None and search_params is not None,
+                    "IVF searchers need index + search_params")
+        expects(health is None or mesh is not None,
+                "ShardHealth only applies to sharded (mesh) searchers")
+        self.kind = kind
+        self.mesh = mesh
+        self.merge_engine = merge_engine
+        self.health = health
+        self.retry = retry
+        self._sleep = sleep
+        self._monotonic = monotonic
+        self._index = index
+        self._params = search_params
+        self._db = db
+        self._base_epoch = 0
+        self._invalidation_hooks: List[Callable[[], None]] = []
+        if kind == "brute_force" and mesh is not None:
+            from raft_tpu.parallel.knn import shard_database
+
+            # Pre-place once: the scheduler calls search per batch and a
+            # host->device transfer of the database per request would
+            # dominate serving latency.
+            self._db = shard_database(mesh, self._db)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def brute_force(cls, db, mesh=None, **kw) -> "Searcher":
+        """Exact kNN endpoint; ``mesh`` shards the database rows
+        (``sharded_knn``), else single-host ``brute_force.knn``."""
+        return cls("brute_force", mesh=mesh, db=db, **kw)
+
+    @classmethod
+    def ivf_flat(cls, index, search_params, mesh=None, **kw) -> "Searcher":
+        """IVF-Flat endpoint over a built index (``ShardedIvfFlat`` when
+        ``mesh`` is given, else the single-host ``ivf_flat.Index``)."""
+        return cls("ivf_flat", mesh=mesh, index=index,
+                   search_params=search_params, **kw)
+
+    @classmethod
+    def ivf_pq(cls, index, search_params, mesh=None, **kw) -> "Searcher":
+        """IVF-PQ endpoint (``ShardedIvfPq`` / ``ivf_pq.Index``)."""
+        return cls("ivf_pq", mesh=mesh, index=index,
+                   search_params=search_params, **kw)
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def dim(self) -> int:
+        """Query dimensionality (what warmup's dummy queries must have)."""
+        if self.kind == "brute_force":
+            return int(self._db.shape[1])
+        return int(self._index.centers.shape[1])
+
+    @property
+    def epoch(self) -> int:
+        """Monotonic index-content version — the cache-invalidation key.
+        Sharded IVF indexes carry their own counter (bumped by the
+        parallel extend paths even when called outside this facade);
+        brute-force / single-host extends count here."""
+        return self._base_epoch + int(getattr(self._index, "epoch", 0))
+
+    def add_invalidation_hook(
+            self, hook: Callable[[], None]) -> Callable[[], None]:
+        """Run ``hook()`` after every extend (the scheduler registers
+        its ResultCache.invalidate here). Returns an idempotent
+        unsubscribe callable — a Searcher outlives its schedulers, so
+        an unremovable hook would retain every retired cache forever."""
+        self._invalidation_hooks.append(hook)
+
+        def remove() -> None:
+            try:
+                self._invalidation_hooks.remove(hook)
+            except ValueError:
+                pass
+
+        return remove
+
+    # -- serving -----------------------------------------------------------
+    def _resolve_live(self, degraded: Optional[bool]):
+        """The live_mask to pass, or None for the (bit-identical,
+        liveness-free) healthy trace. ``degraded=True`` forces the
+        liveness trace even when all ranks are live — warmup uses it to
+        pre-compile the program served during future failures (the mask
+        is a traced operand, so one trace covers every mask value)."""
+        if self.health is None or degraded is False:
+            return None
+        if degraded or not self.health.all_live():
+            return self.health.live_mask
+        return None
+
+    def _dispatch(self, queries: np.ndarray, k: int, live):
+        if self.kind == "brute_force":
+            if self.mesh is None:
+                from raft_tpu.neighbors import brute_force
+
+                return brute_force.knn(self._db, queries, k)
+            from raft_tpu.parallel.knn import sharded_knn
+
+            return sharded_knn(self.mesh, self._db, queries, k,
+                               merge_engine=self.merge_engine,
+                               live_mask=live)
+        if self.kind == "ivf_flat":
+            if self.mesh is None:
+                from raft_tpu.neighbors import ivf_flat
+
+                return ivf_flat.search(self._params, self._index, queries, k)
+            from raft_tpu.parallel.ivf import sharded_ivf_flat_search
+
+            return sharded_ivf_flat_search(self.mesh, self._params,
+                                           self._index, queries, k,
+                                           merge_engine=self.merge_engine,
+                                           live_mask=live)
+        if self.mesh is None:
+            from raft_tpu.neighbors import ivf_pq
+
+            return ivf_pq.search(self._params, self._index, queries, k)
+        from raft_tpu.parallel.ivf import sharded_ivf_pq_search
+
+        return sharded_ivf_pq_search(self.mesh, self._params, self._index,
+                                     queries, k,
+                                     merge_engine=self.merge_engine,
+                                     live_mask=live)
+
+    def search(self, queries, k: int,
+               degraded: Optional[bool] = None) -> SearchResult:
+        """One synchronous search, already shaped (the scheduler owns
+        bucketing/padding). ``degraded=None`` auto-selects: the healthy
+        trace while every shard is live, the live_mask trace (exact over
+        survivors + coverage) as soon as the health registry reports a
+        dead rank. Retries under ``self.retry`` when set."""
+        q = np.asarray(queries)
+        expects(q.ndim == 2, "queries must be (n, dim), got %s", q.shape)
+        expects(q.shape[1] == self.dim, "query dim %s != index dim %s",
+                q.shape[1], self.dim)
+        expects(k >= 1, "k must be >= 1, got %s", k)
+        live = self._resolve_live(degraded)
+
+        def attempt():
+            return self._dispatch(q, k, live)
+
+        if self.retry is not None:
+            out = with_retry(attempt, self.retry, sleep=self._sleep,
+                             monotonic=self._monotonic)
+        else:
+            out = attempt()
+        if len(out) == 3:
+            d, i, cov = out
+            return SearchResult(np.asarray(d), np.asarray(i),
+                                np.asarray(cov), degraded=True)
+        d, i = out
+        return SearchResult(np.asarray(d), np.asarray(i),
+                            np.ones(q.shape[0], np.float32))
+
+    # -- lifecycle ---------------------------------------------------------
+    def extend(self, new_vectors, new_indices=None) -> None:
+        """Grow the underlying index and bump the epoch (invalidating
+        every cached result written against the old contents).
+
+        Sharded endpoints keep the build-time contract: TOTAL rows after
+        the extend must divide the mesh axis (pad the increment upstream
+        — zero-row padding would otherwise surface as fake neighbors)."""
+        if self.kind == "brute_force":
+            import jax.numpy as jnp
+
+            X = jnp.asarray(np.asarray(new_vectors))
+            expects(X.ndim == 2 and X.shape[1] == self.dim,
+                    "new_vectors must be (n, %s), got shape %s", self.dim,
+                    X.shape)
+            db = jnp.concatenate([jnp.asarray(self._db), X], axis=0)
+            if self.mesh is not None:
+                from raft_tpu.parallel.knn import shard_database
+
+                n_dev = self.mesh.shape["data"]
+                expects(db.shape[0] % n_dev == 0,
+                        "extend would leave %s total rows, not divisible "
+                        "by the %s-way mesh — pad the increment upstream",
+                        db.shape[0], n_dev)
+                db = shard_database(self.mesh, db)
+            self._db = db
+            self._base_epoch += 1
+        elif self.mesh is not None:
+            from raft_tpu.parallel.ivf import (sharded_ivf_flat_extend,
+                                               sharded_ivf_pq_extend)
+
+            fn = (sharded_ivf_flat_extend if self.kind == "ivf_flat"
+                  else sharded_ivf_pq_extend)
+            fn(self.mesh, self._index, new_vectors, new_indices)
+        else:
+            from raft_tpu.neighbors import ivf_flat, ivf_pq
+
+            mod = ivf_flat if self.kind == "ivf_flat" else ivf_pq
+            mod.extend(self._index, new_vectors, new_indices)
+            self._base_epoch += 1
+        for hook in self._invalidation_hooks:
+            hook()
+
+    def __repr__(self) -> str:
+        return ("Searcher(kind=%r, sharded=%s, epoch=%s, engine=%r)"
+                % (self.kind, self.mesh is not None, self.epoch,
+                   self.merge_engine))

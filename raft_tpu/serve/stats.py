@@ -1,0 +1,153 @@
+"""Serving-runtime observability: per-bucket counters + compile counting.
+
+Ref pattern: the reference ships no serving layer — its observability
+story stops at NVTX ranges (core/nvtx.hpp) and gbench fixtures
+(cpp/bench/common/benchmark.hpp). An online runtime needs the classic
+scrape surface instead: per-shape-bucket counters (queued, batched,
+padded-slot waste, cache hits, latency quantiles) exposed as a plain
+dict, the role Prometheus client registries play in serving systems.
+
+Two deliberate disciplines, matching ``core/retry.py``:
+
+* **Injectable clock** — latencies are differences of the scheduler's
+  injected monotonic clock, never wall time, so tests assert exact
+  quantiles.
+* **Compile events are observed, not inferred** — :class:`CompileCounter`
+  hooks ``jax.monitoring``'s backend-compile duration events, the ground
+  truth XLA emits per actual compilation, so the "steady-state traffic
+  never recompiles" contract (docs/serving.md) is *proven* rather than
+  assumed from jit-cache keys.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+# One bucket key everywhere: (padded query rows, padded k).
+BucketKey = Tuple[int, int]
+
+#: Latency samples retained per bucket (ring buffer — a serving process
+#: must not grow without bound; p50/p99 over the window is the standard
+#: scrape contract).
+LATENCY_WINDOW = 4096
+
+_COUNTERS = ("requests", "queued", "batches", "batched_requests",
+             "padded_slots", "batched_rows", "cache_hits", "cache_misses",
+             "shed", "deadline_misses", "degraded_responses", "failed",
+             "out_of_grid")
+
+
+class ServeStats:
+    """Per-bucket serving counters, exposed as a plain dict for scraping.
+
+    Thread-safe (request threads submit while a driver thread pumps).
+    Keying convention: per-REQUEST counters (requests, queued, shed,
+    cache hits/misses, deadline_misses, degraded_responses, latency)
+    key on the request's own bucket ``grid.bucket_for(rows, k)`` — the
+    same key at submit and completion, so per-bucket rate/SLO math is
+    consistent; batch-SHAPE counters (batches, batched_requests,
+    batched_rows, padded_slots) key on the dispatched padded shape.
+    Out-of-grid requests use their raw ``(rows, k)``.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: Dict[BucketKey, Dict[str, float]] = {}
+        self._latency: Dict[BucketKey, deque] = {}
+        self.compile_events = 0
+
+    def _b(self, bucket: BucketKey) -> Dict[str, float]:
+        if bucket not in self._buckets:
+            self._buckets[bucket] = {c: 0 for c in _COUNTERS}
+            self._latency[bucket] = deque(maxlen=LATENCY_WINDOW)
+        return self._buckets[bucket]
+
+    def count(self, bucket: BucketKey, counter: str, n: int = 1) -> None:
+        """Add ``n`` to one of the per-bucket counters."""
+        with self._lock:
+            b = self._b(bucket)
+            if counter not in b:
+                raise KeyError(f"unknown counter {counter!r} "
+                               f"(one of {_COUNTERS})")
+            b[counter] += n
+
+    def observe_latency(self, bucket: BucketKey, seconds: float) -> None:
+        """Record one request's submit→complete latency (injected-clock
+        difference)."""
+        with self._lock:
+            self._b(bucket)
+            self._latency[bucket].append(float(seconds))
+
+    def record_compile(self, n: int = 1) -> None:
+        with self._lock:
+            self.compile_events += n
+
+    @staticmethod
+    def _quantile(samples, q: float) -> float:
+        """Nearest-rank quantile — deterministic for the injected-clock
+        tests (no interpolation scheme ambiguity)."""
+        if not samples:
+            return 0.0
+        s = sorted(samples)
+        rank = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+        return s[rank]
+
+    def snapshot(self) -> dict:
+        """Plain-dict scrape of everything: per-bucket counters with
+        p50/p99 latency, plus the global compile-event count."""
+        with self._lock:
+            buckets = {}
+            for key, ctrs in self._buckets.items():
+                lat = list(self._latency[key])
+                row = dict(ctrs)
+                row["latency_p50"] = self._quantile(lat, 0.50)
+                row["latency_p99"] = self._quantile(lat, 0.99)
+                row["latency_samples"] = len(lat)
+                buckets["%dx%d" % key] = row
+            return {"buckets": buckets,
+                    "compile_events": self.compile_events}
+
+
+class CompileCounter:
+    """Context manager counting actual XLA backend compilations.
+
+    Hooks ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+    event — emitted once per real compile, NOT per jit-cache hit — so a
+    test (or the warmup report) can assert "this request stream compiled
+    exactly N programs". Optionally feeds :meth:`ServeStats.record_compile`
+    so the scrape surface carries the same ground truth.
+    """
+
+    def __init__(self, stats: Optional[ServeStats] = None):
+        self.count = 0
+        self._stats = stats
+        self._active = False
+
+    def _listener(self, event: str, duration: float, **kwargs) -> None:
+        if self._active and "backend_compile" in event:
+            self.count += 1
+            if self._stats is not None:
+                self._stats.record_compile()
+
+    def __enter__(self) -> "CompileCounter":
+        import jax.monitoring
+
+        self._active = True
+        jax.monitoring.register_event_duration_secs_listener(self._listener)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        # Deactivate FIRST: even if the private unregister API below has
+        # moved and the listener leaks in jax's global list, it stops
+        # counting and drops its stats reference — no stale feeding.
+        self._active = False
+        self._stats = None
+        try:
+            from jax._src import monitoring as _monitoring
+
+            _monitoring._unregister_event_duration_listener_by_callback(
+                self._listener)
+        except Exception:
+            pass

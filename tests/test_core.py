@@ -178,3 +178,109 @@ class TestTracing:
         for fn in (select_k, ivf_flat.build, ivf_flat.search, ivf_pq.build,
                    ivf_pq.search, kmeans_balanced.fit):
             assert fn.__wrapped__ is not None, fn
+
+
+class TestLoggerTrace:
+    """logger.trace() convenience for the custom TRACE level (ISSUE 5
+    satellite): emits at TRACE, silent one notch above."""
+
+    def _capture(self):
+        import sys
+
+        import raft_tpu.core.logger  # noqa: F401  (ensure registered)
+
+        # The core package rebinds the ``logger`` attribute to the Logger
+        # instance, shadowing the submodule — fetch the module itself.
+        L = sys.modules["raft_tpu.core.logger"]
+
+        lines = []
+        sink = L.set_callback(lambda lvl, msg: lines.append((lvl, msg)))
+        return L, sink, lines
+
+    def test_emits_at_trace_level(self):
+        L, sink, lines = self._capture()
+        old = L.logger.level
+        try:
+            L.set_level(L.TRACE)
+            L.logger.trace("batch %s dispatched (%s rows)", 3, 8)
+            assert len(lines) == 1
+            lvl, msg = lines[0]
+            assert lvl == L.TRACE
+            assert "batch 3 dispatched (8 rows)" in msg
+        finally:
+            L.logger.removeHandler(sink)
+            L.set_level(old)
+
+    def test_silent_above_trace(self):
+        L, sink, lines = self._capture()
+        old = L.logger.level
+        try:
+            L.set_level(L.TRACE + 1)
+            L.logger.trace("invisible %s", 1)
+            L.set_level(L.DEBUG)
+            L.logger.trace("still invisible")
+            assert lines == []
+        finally:
+            L.logger.removeHandler(sink)
+            L.set_level(old)
+
+    def test_module_level_alias(self):
+        L, sink, lines = self._capture()
+        old = L.logger.level
+        try:
+            L.set_level(L.TRACE)
+            L.trace("via module alias")
+            assert len(lines) == 1 and lines[0][0] == L.TRACE
+        finally:
+            L.logger.removeHandler(sink)
+            L.set_level(old)
+
+
+class TestCompilationCacheDir:
+    """enable_compilation_cache must respect an application-configured
+    jax_compilation_cache_dir unless a path is passed explicitly, and
+    return the effective directory (ISSUE 5 satellite)."""
+
+    def test_respects_preconfigured_dir(self, tmp_path):
+        from raft_tpu.core.compilation_cache import enable_compilation_cache
+
+        old = jax.config.jax_compilation_cache_dir
+        app_dir = str(tmp_path / "app_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", app_dir)
+            effective = enable_compilation_cache()
+            assert effective == app_dir
+            assert jax.config.jax_compilation_cache_dir == app_dir
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+
+    def test_explicit_path_still_wins(self, tmp_path):
+        from raft_tpu.core.compilation_cache import enable_compilation_cache
+
+        old = jax.config.jax_compilation_cache_dir
+        app_dir = str(tmp_path / "app_cache")
+        mine = str(tmp_path / "explicit")
+        try:
+            jax.config.update("jax_compilation_cache_dir", app_dir)
+            effective = enable_compilation_cache(mine)
+            assert effective == mine
+            assert jax.config.jax_compilation_cache_dir == mine
+            import os
+
+            assert os.path.isdir(mine)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)
+
+    def test_env_fallback_when_unconfigured(self, tmp_path, monkeypatch):
+        from raft_tpu.core.compilation_cache import enable_compilation_cache
+
+        old = jax.config.jax_compilation_cache_dir
+        env_dir = str(tmp_path / "env_cache")
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+            monkeypatch.setenv("RAFT_TPU_XLA_CACHE", env_dir)
+            effective = enable_compilation_cache()
+            assert effective == env_dir
+            assert jax.config.jax_compilation_cache_dir == env_dir
+        finally:
+            jax.config.update("jax_compilation_cache_dir", old)

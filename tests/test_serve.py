@@ -1,0 +1,717 @@
+"""Serving-runtime suite (raft_tpu/serve/): bucketing, scheduler,
+cache, searcher facade, stats — the acceptance grid of ISSUE 5.
+
+Everything timing-related runs on an injected monotonic clock (no wall
+time, matching core/retry.py discipline); compilation claims are proven
+with the jax.monitoring backend-compile event hook, not inferred from
+jit cache keys.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from raft_tpu.comms import ShardHealth
+from raft_tpu.core.error import LogicError
+from raft_tpu.core.retry import RetryPolicy
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.parallel import (
+    shard_database,
+    sharded_ivf_flat_build,
+    sharded_ivf_flat_search,
+    sharded_ivf_flat_extend,
+    sharded_ivf_pq_build,
+    sharded_ivf_pq_extend,
+    sharded_knn,
+)
+from raft_tpu.serve import (
+    BatchPolicy,
+    BatchScheduler,
+    BucketGrid,
+    CompileCounter,
+    Overloaded,
+    ResultCache,
+    Searcher,
+    SearchResult,
+    ServeStats,
+    pad_queries,
+    warmup,
+)
+
+N_DEV = 4
+DIM = 16
+N_DB = 256
+
+
+class Clock:
+    """Injected monotonic clock: tests advance it explicitly."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices())
+    assert devs.size >= N_DEV, "conftest must force >= 4 virtual devices"
+    return Mesh(devs[:N_DEV], ("data",))
+
+
+@pytest.fixture(scope="module")
+def db():
+    rng = np.random.default_rng(7)
+    return rng.normal(size=(N_DB, DIM)).astype(np.float32)
+
+
+def make_queries(rng, n):
+    return rng.normal(size=(n, DIM)).astype(np.float32)
+
+
+def make_sched(searcher, grid=None, clock=None, cache=None, **policy_kw):
+    grid = grid or BucketGrid.pow2(16, k_grid=(5, 10))
+    policy = BatchPolicy(**{"max_batch": 16, "max_wait": 0.01,
+                            "max_queue": 64, **policy_kw})
+    return BatchScheduler(searcher, grid, policy, cache=cache,
+                          stats=ServeStats(), clock=clock or Clock())
+
+
+# ---------------------------------------------------------------------------
+# Bucketing
+
+
+class TestBucketGrid:
+    def test_pow2_ladder(self):
+        g = BucketGrid.pow2(12, k_grid=(1, 10))
+        assert g.q_buckets == (1, 2, 4, 8, 16)
+        assert g.bucket_queries(3) == 4
+        assert g.bucket_queries(16) == 16
+        assert g.bucket_queries(17) is None
+        assert g.bucket_k(7) == 10
+        assert g.bucket_k(11) is None
+        assert g.bucket_for(5, 2) == (8, 10)
+        assert g.shapes() == tuple((q, k) for q in (1, 2, 4, 8, 16)
+                                   for k in (1, 10))
+
+    def test_validation(self):
+        with pytest.raises(LogicError):
+            BucketGrid(q_buckets=(4, 2), k_grid=(10,))
+        with pytest.raises(LogicError):
+            BucketGrid(q_buckets=(), k_grid=(10,))
+        with pytest.raises(LogicError):
+            BucketGrid(q_buckets=(1, 2), k_grid=(10, 10))
+
+    def test_pad_queries(self):
+        q = np.ones((3, DIM), np.float32)
+        p = pad_queries(q, 8)
+        assert p.shape == (8, DIM)
+        np.testing.assert_array_equal(p[:3], q)
+        assert not p[3:].any()
+        assert pad_queries(q, 3) is q
+        with pytest.raises(LogicError):
+            pad_queries(q, 2)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (a): zero compilation in steady state after warmup
+
+
+def test_warmup_then_zero_compiles(mesh4, db):
+    """A mixed-size request stream inside the bucket grid triggers ZERO
+    XLA compilations after warmup (the compile-counting hook observes
+    the backend_compile events XLA actually emits)."""
+    s = Searcher.brute_force(db, mesh=mesh4, merge_engine="ring")
+    grid = BucketGrid.pow2(16, k_grid=(5, 10))
+    report = warmup(s, grid)
+    assert report["shapes"] == len(grid.shapes())
+    clock = Clock()
+    sched = make_sched(s, grid, clock)
+    rng = np.random.default_rng(11)
+    with CompileCounter() as counter:
+        tickets = []
+        for n, k in [(1, 5), (3, 10), (7, 5), (16, 10), (2, 5), (9, 10),
+                     (4, 5), (13, 10), (16, 5), (1, 10)]:
+            tickets.append(sched.submit(make_queries(rng, n), k))
+            clock.advance(0.02)
+            sched.pump()
+        sched.run_until_idle()
+    assert all(t.done for t in tickets)
+    assert counter.count == 0, (
+        "steady-state in-grid traffic recompiled %d programs"
+        % counter.count)
+
+
+def test_warmup_degraded_covers_failure_masks(mesh4, db):
+    """The liveness trace is warmed with the all-live mask; any later
+    mask value (a real failure) reuses it — masks are traced operands,
+    not static shapes."""
+    health = ShardHealth(N_DEV)
+    s = Searcher.brute_force(db, mesh=mesh4, merge_engine="allgather",
+                             health=health)
+    grid = BucketGrid(q_buckets=(4,), k_grid=(5,))
+    warmup(s, grid, include_degraded=True)
+    health.mark_dead(2)
+    rng = np.random.default_rng(3)
+    with CompileCounter() as counter:
+        res = s.search(make_queries(rng, 4), 5)
+    assert res.degraded
+    assert counter.count == 0
+
+
+def test_warmup_during_outage_still_warms_healthy_trace(mesh4, db):
+    """warmup while a shard is ALREADY dead must still compile the
+    healthy (liveness-free) trace — otherwise recovery would compile-
+    storm in the serving hot path."""
+    health = ShardHealth(N_DEV)
+    health.mark_dead(1)                     # outage before boot
+    s = Searcher.brute_force(db, mesh=mesh4, merge_engine="allgather",
+                             health=health)
+    grid = BucketGrid(q_buckets=(8,), k_grid=(5,))
+    warmup(s, grid, include_degraded=True)
+    health.mark_live(1)                     # recovery
+    rng = np.random.default_rng(137)
+    with CompileCounter() as counter:
+        res = s.search(make_queries(rng, 8), 5)
+    assert not res.degraded
+    assert counter.count == 0
+
+
+def test_scheduler_close_unhooks_cache(mesh4, db):
+    """A retired scheduler must not keep its cache wired into the
+    long-lived Searcher's extend hooks."""
+    s = Searcher.brute_force(db, mesh=mesh4)
+    old_cache = ResultCache(8)
+    old = make_sched(s, cache=old_cache)
+    assert len(s._invalidation_hooks) == 1
+    old.close()
+    assert len(s._invalidation_hooks) == 0
+    old.close()                             # idempotent
+    fresh = make_sched(s, cache=ResultCache(8))
+    assert len(s._invalidation_hooks) == 1
+    rng = np.random.default_rng(139)
+    old_cache.put(s.epoch, make_queries(rng, 1), 5, "stale")
+    s.extend(make_queries(rng, N_DEV))      # fires only the live hook
+    assert len(old_cache) == 1              # retired cache untouched
+    assert len(fresh.cache) == 0
+
+
+def test_warmup_degraded_requires_health(mesh4, db):
+    """include_degraded without a ShardHealth would warm nothing and
+    falsely report failure-readiness — rejected instead."""
+    s = Searcher.brute_force(db, mesh=mesh4)
+    with pytest.raises(LogicError):
+        warmup(s, BucketGrid(q_buckets=(2,), k_grid=(5,)),
+               include_degraded=True)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (b): batched results bit-identical to per-request calls
+
+
+@pytest.mark.parametrize("engine", ["allgather", "ring", "ring_bf16"])
+def test_batched_equals_per_request(mesh4, db, engine):
+    """The scheduler's pad→batch→slice pipeline returns bit-identical
+    (distances, indices) to one direct sharded_knn call per request,
+    for every merge engine."""
+    s = Searcher.brute_force(db, mesh=mesh4, merge_engine=engine)
+    clock = Clock()
+    sched = make_sched(s, clock=clock)
+    rng = np.random.default_rng(23)
+    reqs = [(make_queries(rng, n), k)
+            for n, k in [(1, 5), (3, 10), (7, 5), (5, 5), (16, 10),
+                         (2, 10)]]
+    tickets = [sched.submit(q, k) for q, k in reqs]
+    sched.run_until_idle()
+    for (q, k), t in zip(reqs, tickets):
+        got = t.result()
+        # Results own their memory — a batch-buffer view would pin the
+        # whole padded dispatch array in the cache.
+        assert got.distances.base is None and got.indices.base is None
+        want_d, want_i = sharded_knn(mesh4, db, q, k, merge_engine=engine)
+        np.testing.assert_array_equal(got.distances,
+                                      np.asarray(want_d)[:, :k])
+        np.testing.assert_array_equal(got.indices,
+                                      np.asarray(want_i)[:, :k])
+        np.testing.assert_array_equal(got.coverage,
+                                      np.ones(q.shape[0], np.float32))
+
+
+def test_batched_equals_per_request_ivf_flat(mesh4, db):
+    """Same parity through the IVF-Flat sharded path (k is bucketed up
+    to the grid k and sliced back down)."""
+    params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+    index = sharded_ivf_flat_build(mesh4, params, db)
+    sp = ivf_flat.SearchParams(n_probes=4)
+    s = Searcher.ivf_flat(index, sp, mesh=mesh4, merge_engine="ring")
+    sched = make_sched(s)
+    rng = np.random.default_rng(29)
+    reqs = [(make_queries(rng, n), k) for n, k in [(2, 5), (6, 10), (3, 5)]]
+    tickets = [sched.submit(q, k) for q, k in reqs]
+    sched.run_until_idle()
+    for (q, k), t in zip(reqs, tickets):
+        want_d, want_i = sharded_ivf_flat_search(mesh4, sp, index, q, k,
+                                                 merge_engine="ring")
+        np.testing.assert_array_equal(t.result().distances,
+                                      np.asarray(want_d)[:, :k])
+        np.testing.assert_array_equal(t.result().indices,
+                                      np.asarray(want_i)[:, :k])
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (c): dead shard — keeps serving, correct coverage, no raise
+
+
+def test_dead_shard_serves_degraded_with_coverage(mesh4, db):
+    health = ShardHealth(N_DEV)
+    s = Searcher.brute_force(db, mesh=mesh4, merge_engine="ring",
+                             health=health)
+    clock = Clock()
+    sched = make_sched(s, clock=clock)
+    rng = np.random.default_rng(31)
+    health.mark_dead(1)
+    tickets = [sched.submit(make_queries(rng, n), 5) for n in (2, 5, 3)]
+    sched.run_until_idle()
+    shard = N_DB // N_DEV
+    live_rows = np.r_[0:shard, 2 * shard:N_DB]
+    for t in tickets:
+        res = t.result()          # no request raises
+        assert res.degraded
+        np.testing.assert_allclose(res.coverage,
+                                   np.full(res.coverage.shape, 0.75),
+                                   rtol=1e-6)
+        # Exact over the survivors: every returned id is a live row.
+        assert np.isin(res.indices, live_rows).all()
+    snap = sched.stats.snapshot()
+    assert sum(b["degraded_responses"]
+               for b in snap["buckets"].values()) == 3
+
+
+def test_degraded_results_not_cached_across_recovery(mesh4, db):
+    """A partial-coverage answer must not be replayed from cache after
+    the shard comes back."""
+    health = ShardHealth(N_DEV)
+    s = Searcher.brute_force(db, mesh=mesh4, health=health)
+    cache = ResultCache(32)
+    sched = make_sched(s, cache=cache)
+    rng = np.random.default_rng(37)
+    q = make_queries(rng, 3)
+    health.mark_dead(0)
+    t = sched.submit(q, 5)
+    sched.run_until_idle()
+    assert t.result().degraded and len(cache) == 0
+    health.mark_live(0)
+    t2 = sched.submit(q, 5)
+    sched.run_until_idle()
+    assert not t2.result().degraded
+    np.testing.assert_array_equal(t2.result().coverage, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (d): queue-full admission control
+
+
+def test_overloaded_sheds_deterministically(mesh4, db):
+    s = Searcher.brute_force(db, mesh=mesh4)
+    clock = Clock()
+    sched = make_sched(s, clock=clock, max_queue=3)
+    rng = np.random.default_rng(41)
+    ok = [sched.submit(make_queries(rng, 1), 5) for _ in range(3)]
+    for _ in range(2):            # every over-bound submit sheds
+        with pytest.raises(Overloaded):
+            sched.submit(make_queries(rng, 1), 5)
+    shed = sum(b["shed"]
+               for b in sched.stats.snapshot()["buckets"].values())
+    assert shed == 2
+    sched.run_until_idle()        # queued work survives the shedding
+    assert all(t.done for t in ok)
+    sched.submit(make_queries(rng, 1), 5)   # drained queue admits again
+
+
+# ---------------------------------------------------------------------------
+# Scheduler timing semantics (injected clock)
+
+
+class TestSchedulerTiming:
+    def test_waits_then_flushes_at_max_wait(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        clock = Clock()
+        sched = make_sched(s, clock=clock, max_wait=0.01)
+        rng = np.random.default_rng(43)
+        t = sched.submit(make_queries(rng, 2), 5)
+        assert sched.pump() == 0 and not t.done     # not ripe yet
+        clock.now = 0.009
+        assert sched.pump() == 0 and not t.done     # still inside window
+        clock.now = 0.01
+        assert sched.pump() == 1 and t.done         # exactly at max_wait
+
+    def test_full_batch_dispatches_immediately(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s, max_batch=8, max_wait=100.0)
+        rng = np.random.default_rng(47)
+        a = sched.submit(make_queries(rng, 5), 5)
+        assert sched.pump() == 0                    # 5 < 8 rows
+        b = sched.submit(make_queries(rng, 3), 5)
+        assert sched.pump() == 2                    # 8 rows: no waiting
+        assert a.done and b.done
+
+    def test_deadline_pressure_flushes_early(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        clock = Clock()
+        sched = make_sched(s, clock=clock, max_wait=10.0)
+        rng = np.random.default_rng(53)
+        t = sched.submit(make_queries(rng, 2), 5, deadline=clock.now + 0.05)
+        # Waiting the full 10 s window would blow the 50 ms deadline:
+        # the scheduler dispatches under-filled instead.
+        assert sched.pump() == 1 and t.done
+        misses = sum(b["deadline_misses"]
+                     for b in sched.stats.snapshot()["buckets"].values())
+        assert misses == 0
+
+    def test_missed_deadline_is_counter_not_exception(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        clock = Clock()
+        sched = make_sched(s, clock=clock)
+        rng = np.random.default_rng(59)
+        t = sched.submit(make_queries(rng, 2), 5, deadline=clock.now + 0.001)
+        clock.advance(1.0)        # deadline long gone before any pump
+        sched.pump()
+        assert t.done and t.result().distances.shape == (2, 5)
+        misses = sum(b["deadline_misses"]
+                     for b in sched.stats.snapshot()["buckets"].values())
+        assert misses == 1
+
+    def test_distinct_k_never_share_a_batch(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(61)
+        sched.submit(make_queries(rng, 2), 5)
+        sched.submit(make_queries(rng, 2), 10)
+        sched.flush()
+        snap = sched.stats.snapshot()["buckets"]
+        assert snap["2x5"]["batches"] == 1
+        assert snap["2x10"]["batches"] == 1
+
+    def test_ticket_result_before_done_raises(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(67)
+        t = sched.submit(make_queries(rng, 1), 5)
+        with pytest.raises(LogicError):
+            t.result()
+
+    def test_oversized_request_rejected_at_submit(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(71)
+        with pytest.raises(LogicError):
+            sched.submit(make_queries(rng, 17), 5)   # grid max is 16
+
+    def test_dim_mismatch_rejected_at_submit_not_dispatch(self, mesh4, db):
+        """A bad-dim request must shed at admission — co-batched with a
+        good request it would otherwise fail the whole batch."""
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(127)
+        good = sched.submit(make_queries(rng, 2), 5)
+        with pytest.raises(LogicError):
+            sched.submit(rng.normal(size=(2, DIM + 1)).astype(np.float32),
+                         5)
+        sched.run_until_idle()
+        assert good.result().distances.shape == (2, 5)
+
+
+# ---------------------------------------------------------------------------
+# Result cache
+
+
+class TestResultCache:
+    def test_exact_hit_and_epoch_isolation(self):
+        cache = ResultCache(8)
+        q = np.arange(8, dtype=np.float32).reshape(2, 4)
+        res = SearchResult(np.zeros((2, 5)), np.zeros((2, 5), np.int32),
+                           np.ones(2, np.float32))
+        cache.put(0, q, 5, res)
+        assert cache.get(0, q, 5) is res
+        assert cache.get(1, q, 5) is None           # new epoch: miss
+        assert cache.get(0, q, 6) is None           # different k: miss
+        assert cache.get(0, q + 1e-7, 5) is None    # exact bytes only
+        assert cache.hits == 1 and cache.misses == 3
+
+    def test_shape_rides_in_key(self):
+        cache = ResultCache(8)
+        a = np.zeros((1, 4), np.float32)
+        b = np.zeros((4, 1), np.float32)            # same tobytes()
+        cache.put(0, a, 5, "A")
+        assert cache.get(0, b, 5) is None
+
+    def test_lru_eviction_order(self):
+        cache = ResultCache(2)
+        qs = [np.full((1, 2), i, np.float32) for i in range(3)]
+        cache.put(0, qs[0], 5, "r0")
+        cache.put(0, qs[1], 5, "r1")
+        assert cache.get(0, qs[0], 5) == "r0"       # refresh q0
+        cache.put(0, qs[2], 5, "r2")                # evicts q1 (LRU)
+        assert cache.get(0, qs[1], 5) is None
+        assert cache.get(0, qs[0], 5) == "r0"
+        assert cache.evictions == 1
+
+    def test_invalidate(self):
+        cache = ResultCache(8)
+        q = np.zeros((1, 2), np.float32)
+        cache.put(0, q, 5, "old")
+        cache.put(1, q, 5, "new")
+        assert cache.invalidate(epoch=0) == 1
+        assert cache.get(1, q, 5) == "new"
+        assert cache.invalidate() == 1 and len(cache) == 0
+
+    def test_scheduler_cache_hit_skips_search(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        cache = ResultCache(16)
+        sched = make_sched(s, cache=cache)
+        rng = np.random.default_rng(73)
+        q = make_queries(rng, 3)
+        t1 = sched.submit(q, 5)
+        sched.run_until_idle()
+        t2 = sched.submit(q, 5)                     # immediate, no queue
+        assert t2.done and sched.pending() == 0
+        np.testing.assert_array_equal(t1.result().distances,
+                                      t2.result().distances)
+        assert cache.snapshot()["hits"] == 1
+
+    def test_extend_invalidates_through_scheduler(self, mesh4, db):
+        rng = np.random.default_rng(79)
+        s = Searcher.brute_force(db, mesh=mesh4)
+        cache = ResultCache(16)
+        sched = make_sched(s, cache=cache)
+        q = make_queries(rng, 2)
+        sched.submit(q, 5)
+        sched.run_until_idle()
+        assert len(cache) == 1
+        e0 = s.epoch
+        s.extend(make_queries(rng, 2 * N_DEV))      # rows divide the mesh
+        assert s.epoch == e0 + 1 and len(cache) == 0
+        t = sched.submit(q, 5)                      # re-queued, not a hit
+        assert not t.done
+        sched.run_until_idle()
+        assert t.result().indices.max() >= 0
+
+
+# ---------------------------------------------------------------------------
+# Epoch plumbing (parallel/ivf.py)
+
+
+def test_sharded_extend_bumps_epoch(mesh4, db):
+    params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+    index = sharded_ivf_flat_build(mesh4, params, db)
+    assert index.epoch == 0
+    sharded_ivf_flat_extend(mesh4, index,
+                            np.random.default_rng(83).normal(
+                                size=(2 * N_DEV, DIM)).astype(np.float32))
+    assert index.epoch == 1
+    from raft_tpu.neighbors import ivf_pq
+
+    pq_params = ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=2)
+    pidx = sharded_ivf_pq_build(mesh4, pq_params, db)
+    assert pidx.epoch == 0
+    sharded_ivf_pq_extend(mesh4, pidx,
+                          np.random.default_rng(89).normal(
+                              size=(2 * N_DEV, DIM)).astype(np.float32))
+    assert pidx.epoch == 1
+
+
+# ---------------------------------------------------------------------------
+# Searcher facade
+
+
+class TestSearcher:
+    def test_single_host_brute_force(self, db):
+        from raft_tpu.neighbors import brute_force
+
+        s = Searcher.brute_force(db)
+        q = make_queries(np.random.default_rng(97), 4)
+        res = s.search(q, 5)
+        want_d, want_i = brute_force.knn(db, q, 5)
+        np.testing.assert_array_equal(res.distances, np.asarray(want_d))
+        np.testing.assert_array_equal(res.indices, np.asarray(want_i))
+        assert not res.degraded
+
+    def test_single_host_ivf_flat(self, db):
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = ivf_flat.build(params, db)
+        sp = ivf_flat.SearchParams(n_probes=4)
+        s = Searcher.ivf_flat(index, sp)
+        rng = np.random.default_rng(101)
+        q = make_queries(rng, 3)
+        res = s.search(q, 5)
+        want_d, want_i = ivf_flat.search(sp, index, q, 5)
+        np.testing.assert_array_equal(res.distances, np.asarray(want_d))
+        np.testing.assert_array_equal(res.indices, np.asarray(want_i))
+
+    def test_retry_policy_threads_through(self, mesh4, db):
+        """A transient fault inside the search call retries under the
+        deterministic policy and still answers."""
+        s = Searcher.brute_force(db, mesh=mesh4,
+                                 retry=RetryPolicy(max_attempts=3,
+                                                   base_delay=0.0),
+                                 sleep=lambda _t: None)
+        fails = {"left": 2}
+        orig = s._dispatch
+
+        def flaky(q, k, live):
+            if fails["left"]:
+                fails["left"] -= 1
+                raise OSError("transient")
+            return orig(q, k, live)
+
+        s._dispatch = flaky
+        rng = np.random.default_rng(103)
+        res = s.search(make_queries(rng, 2), 5)
+        assert res.distances.shape == (2, 5) and fails["left"] == 0
+
+    def test_search_error_fails_ticket_not_scheduler(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(107)
+        orig = s._dispatch
+
+        def explode(q, k, live):
+            raise RuntimeError("shard exploded")
+
+        s._dispatch = explode
+        t = sched.submit(make_queries(rng, 2), 5)
+        sched.run_until_idle()                      # must not raise
+        with pytest.raises(RuntimeError):
+            t.result()
+        failed = sum(b["failed"]
+                     for b in sched.stats.snapshot()["buckets"].values())
+        assert failed == 1                          # outage visible in scrape
+        s._dispatch = orig                          # scheduler still serves
+        t2 = sched.submit(make_queries(rng, 2), 5)
+        sched.run_until_idle()
+        assert t2.result().distances.shape == (2, 5)
+
+    def test_sharded_extend_rejects_non_divisible_total(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        with pytest.raises(LogicError):
+            s.extend(np.zeros((1, DIM), np.float32))  # 257 % 4 != 0
+        with pytest.raises(LogicError):
+            s.extend(np.zeros(DIM, np.float32))       # 1-D: clean error
+        assert s.epoch == 0                           # nothing mutated
+
+    def test_validation(self, db, mesh4):
+        with pytest.raises(LogicError):
+            Searcher("nope", db=db)
+        with pytest.raises(LogicError):
+            Searcher("ivf_flat", index=None, search_params=None)
+        with pytest.raises(LogicError):
+            Searcher.brute_force(db, health=ShardHealth(4))  # needs mesh
+        s = Searcher.brute_force(db, mesh=mesh4)
+        with pytest.raises(LogicError):
+            s.search(np.zeros((2, DIM + 1), np.float32), 5)
+
+
+# ---------------------------------------------------------------------------
+# Stats
+
+
+class TestServeStats:
+    def test_padded_slot_accounting(self, mesh4, db):
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        rng = np.random.default_rng(109)
+        sched.submit(make_queries(rng, 5), 5)       # pads 5 -> 8
+        sched.flush()
+        snap = sched.stats.snapshot()["buckets"]["8x5"]
+        assert snap["batches"] == 1
+        assert snap["batched_rows"] == 5
+        assert snap["padded_slots"] == 3
+
+    def test_request_counters_key_on_request_bucket(self, mesh4, db):
+        """Submit-side and completion-side stats for one request land in
+        the SAME bucket even when it co-batches into a larger dispatch
+        shape — per-bucket rate/SLO math must be self-consistent."""
+        s = Searcher.brute_force(db, mesh=mesh4)
+        clock = Clock()
+        sched = make_sched(s, clock=clock)
+        rng = np.random.default_rng(131)
+        sched.submit(make_queries(rng, 3), 5)       # bucket (4, 5)
+        sched.submit(make_queries(rng, 3), 5)       # bucket (4, 5)
+        clock.advance(0.02)
+        sched.pump()                                # one 6-row -> 8x5 batch
+        snap = sched.stats.snapshot()["buckets"]
+        assert snap["8x5"]["batches"] == 1          # dispatch shape
+        assert snap["8x5"]["latency_samples"] == 0
+        assert snap["4x5"]["requests"] == 2         # request bucket
+        assert snap["4x5"]["latency_samples"] == 2
+        assert snap["4x5"]["latency_p50"] == pytest.approx(0.02)
+
+    def test_latency_quantiles_from_injected_clock(self):
+        stats = ServeStats()
+        for ms in range(1, 101):
+            stats.observe_latency((8, 5), ms / 1000.0)
+        b = stats.snapshot()["buckets"]["8x5"]
+        assert b["latency_samples"] == 100
+        assert b["latency_p50"] == pytest.approx(0.050, abs=1e-3)
+        assert b["latency_p99"] == pytest.approx(0.099, abs=1e-3)
+
+    def test_unknown_counter_rejected(self):
+        with pytest.raises(KeyError):
+            ServeStats().count((1, 1), "qubits")
+
+    def test_snapshot_is_plain_data(self, mesh4, db):
+        import json
+
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = make_sched(s)
+        sched.submit(np.zeros((2, DIM), np.float32), 5)
+        sched.flush()
+        json.dumps(sched.stats.snapshot())          # scrapable as-is
+
+
+# ---------------------------------------------------------------------------
+# shard_database helper
+
+
+def test_shard_database_placement_and_parity(mesh4, db):
+    placed = shard_database(mesh4, db)
+    rng = np.random.default_rng(113)
+    q = make_queries(rng, 4)
+    d0, i0 = sharded_knn(mesh4, db, q, 5)
+    d1, i1 = sharded_knn(mesh4, placed, q, 5)
+    np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+    np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+    with pytest.raises(LogicError):
+        shard_database(mesh4, db[:N_DB - 1])        # 255 rows % 4 != 0
+
+
+# ---------------------------------------------------------------------------
+# Bench smoke (keeps bench/serve.py from rotting; the sharded bench has
+# the same tier-1 smoke contract)
+
+
+def test_bench_serve_family_smoke(capsys):
+    import json
+
+    from bench.serve import run
+
+    run(quick=True)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(lines) >= 3
+    recs = {}
+    for line in lines:
+        rec = json.loads(line)
+        recs[rec["metric"]] = rec
+        assert rec["value"] >= 0
+    assert {"serve_qps", "serve_padded_waste_pct",
+            "serve_cache_hit_rate"} <= set(recs)
+    # The 30%-repeat stream must actually hit (a saturation drive that
+    # checks every submit against a still-empty cache reads ~0).
+    assert recs["serve_cache_hit_rate"]["value"] > 0.1

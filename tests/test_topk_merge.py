@@ -294,3 +294,83 @@ def test_bench_sharded_family_smoke(capsys):
     if n_dev >= 4:
         assert (by_engine["ring"]["est_exchange_bytes"]
                 < by_engine["allgather"]["est_exchange_bytes"])
+
+
+class TestKnnMergePartsEdgeCases:
+    """knn_merge_parts edge inputs (ISSUE 5 satellite): single part,
+    parts with fewer real candidates than k (sentinel-padded), and a
+    fully dead (all-sentinel) part — the exact shapes the degraded
+    serving path feeds the merge."""
+
+    def test_single_part_sorts_and_translates(self, rng):
+        from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+        keys = rng.random(size=(1, 5, 4)).astype(np.float32)
+        vals = np.tile(np.arange(4, dtype=np.int32), (1, 5, 1))
+        mk, mv = knn_merge_parts(jnp.asarray(keys), jnp.asarray(vals),
+                                 translations=[100])
+        order = np.argsort(keys[0], axis=1)
+        np.testing.assert_allclose(np.asarray(mk),
+                                   np.take_along_axis(keys[0], order, 1))
+        np.testing.assert_array_equal(
+            np.asarray(mv), np.take_along_axis(vals[0] + 100, order, 1))
+
+    def test_k_exceeds_real_candidates_per_part(self, rng):
+        """Parts padded to k slots with the +inf/-1 sentinels (the knn()
+        small-part convention): every real candidate from every part
+        must outrank every sentinel, and only the overflow tail may be
+        sentinel."""
+        from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+        k = 6
+        n_parts, q, real = 2, 3, 2          # 4 real candidates < k = 6
+        keys = np.full((n_parts, q, k), np.inf, np.float32)
+        vals = np.full((n_parts, q, k), -1, np.int32)
+        keys[:, :, :real] = rng.random(
+            size=(n_parts, q, real)).astype(np.float32)
+        vals[:, :, :real] = np.arange(real, dtype=np.int32)
+        mk, mv = knn_merge_parts(jnp.asarray(keys), jnp.asarray(vals),
+                                 translations=[0, 10])
+        mk, mv = np.asarray(mk), np.asarray(mv)
+        total_real = n_parts * real
+        assert np.isfinite(mk[:, :total_real]).all()
+        assert (mv[:, :total_real] >= 0).all()
+        # The overflow tail is exactly the sentinel pair.
+        assert np.isinf(mk[:, total_real:]).all()
+        assert (mv[:, total_real:] == -1).all()
+        # And the real prefix is the sorted union of the parts' reals.
+        want = np.sort(keys[:, :, :real].transpose(1, 0, 2).reshape(q, -1),
+                       axis=1)
+        np.testing.assert_allclose(mk[:, :total_real], want)
+
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_all_sentinel_dead_part_is_neutral(self, rng, select_min):
+        """A fully dead part (all ±inf/-1 — what neutralize_dead emits
+        for a dead shard) must not perturb the merge: result equals the
+        merge of the surviving parts alone."""
+        from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+        worst = np.inf if select_min else -np.inf
+        live = rng.random(size=(2, 4, 3)).astype(np.float32)
+        vals = np.tile(np.arange(3, dtype=np.int32), (2, 4, 1))
+        dead_k = np.full((1, 4, 3), worst, np.float32)
+        dead_v = np.full((1, 4, 3), -1, np.int32)
+        keys3 = np.concatenate([live[:1], dead_k, live[1:]], axis=0)
+        vals3 = np.concatenate([vals[:1], dead_v, vals[1:]], axis=0)
+        mk3, mv3 = knn_merge_parts(jnp.asarray(keys3), jnp.asarray(vals3),
+                                   select_min=select_min,
+                                   translations=[0, 100, 200])
+        mk2, mv2 = knn_merge_parts(jnp.asarray(live), jnp.asarray(vals),
+                                   select_min=select_min,
+                                   translations=[0, 200])
+        np.testing.assert_array_equal(np.asarray(mk3), np.asarray(mk2))
+        np.testing.assert_array_equal(np.asarray(mv3), np.asarray(mv2))
+
+    def test_all_parts_dead_returns_sentinels(self):
+        from raft_tpu.neighbors.brute_force import knn_merge_parts
+
+        keys = np.full((3, 2, 4), np.inf, np.float32)
+        vals = np.full((3, 2, 4), -1, np.int32)
+        mk, mv = knn_merge_parts(jnp.asarray(keys), jnp.asarray(vals))
+        assert np.isinf(np.asarray(mk)).all()
+        assert (np.asarray(mv) == -1).all()
