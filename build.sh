@@ -17,8 +17,10 @@ do_native() {
 }
 
 do_style() {
-  # Style/hygiene gate (ref: ci/check_style.sh + cpp/scripts style tools).
-  python ci/check_style.py
+  # Static gate (ref: ci/check_style.sh + cpp/scripts style tools):
+  # style/citation checks plus the TPU tracing-safety & concurrency
+  # analyzer (docs/static_analysis.md).
+  python ci/analyze.py
 }
 
 do_tests() {

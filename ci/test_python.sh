@@ -1,20 +1,28 @@
 #!/usr/bin/env bash
-# Python test gate (ref: ci/test_python.sh) — style first, then the suite.
+# Python test gate (ref: ci/test_python.sh) — static analysis first,
+# then the suite.
 #
-# Three lanes:
+# Four lanes:
+#   * analyze: graft-analyze (ci/analyze.py) — style/citation checks
+#     plus the five TPU semantic checks (host-sync, axis-name,
+#     epoch-bump, lock-discipline, sentinel); blocking, must be clean
+#     (waivers live inline next to the code — docs/static_analysis.md);
 #   * tier-1: everything except the chaos marker (the fast correctness
 #     gate — fault-injection stays out of its budget);
 #   * chaos:  the deterministic fault-injection lane
 #     (raft_tpu/testing/chaos.py harness; seeded, no wall-clock
 #     randomness, so a CI failure replays bit-for-bit locally with
 #     `pytest -m chaos`);
-#   * serve:  fast re-run of the serving-runtime acceptance suite in
-#     isolation (injected clock + compile-counting hook; catches
-#     ordering dependencies the full-suite run can mask, e.g. a bucket
-#     shape another test happened to compile first).
+#   * sanitize: the runtime cross-check of the analyzer's host-sync
+#     claim — marked hot-path tests re-run in isolation under
+#     jax.transfer_guard("disallow") + CompileCounter (zero guarded
+#     transfers, zero steady-state compiles), together with the serving
+#     acceptance suite (injected clock + compile-event hook; isolation
+#     catches shape-warmup ordering the full run can mask).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python ci/check_style.py
+python ci/analyze.py
 python -m pytest tests/ -x -q -m "not chaos"
 python -m pytest tests/ -x -q -m "chaos"
-python -m pytest tests/test_serve.py -x -q
+python -m pytest tests/ -x -q -m "sanitized"
+python -m pytest tests/test_serve.py tests/test_analyze.py -x -q

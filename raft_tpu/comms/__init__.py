@@ -24,6 +24,8 @@ from raft_tpu.comms.comms_test import (
     test_collective_allreduce,
     test_collective_allreduce_prod,
     test_collective_gatherv,
+    test_collective_allgatherv,
+    test_collective_gather,
     test_collective_broadcast,
     test_collective_reduce,
     test_collective_allgather,
@@ -40,7 +42,8 @@ __all__ = [
     "MERGE_ENGINES", "merge_comm_bytes", "merge_parts",
     "resolve_merge_engine", "topk_merge",
     "test_collective_allreduce", "test_collective_allreduce_prod",
-    "test_collective_gatherv", "test_collective_broadcast",
+    "test_collective_gatherv", "test_collective_allgatherv",
+    "test_collective_gather", "test_collective_broadcast",
     "test_collective_reduce", "test_collective_allgather",
     "test_collective_reducescatter", "test_pointToPoint_simple_send_recv",
     "test_pointToPoint_device_multicast_sendrecv",

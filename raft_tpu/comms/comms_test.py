@@ -110,6 +110,53 @@ def test_collective_gatherv(mesh: Mesh, axis: str = "data",
             and _check(counts, counts_exp))
 
 
+def test_collective_allgatherv(mesh: Mesh, axis: str = "data") -> bool:
+    """Padded variable-count allgather: every rank sees every shard plus
+    its valid count (ref: test_collective_allgatherv,
+    comms/detail/test.hpp — padded shards + counts, caller masks)."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+    pad = n  # max count
+
+    def body(x):
+        r = comms.get_rank()
+        cnt = r + 1
+        mine = jnp.where(jnp.arange(pad) < cnt,
+                         r.astype(jnp.float32) + 10.0, 0.0)
+        shards, counts = comms.allgatherv(mine, cnt[None])
+        return shards.reshape(-1)[None], counts.reshape(-1)[None]
+
+    shards, counts = _run(mesh, axis, body, (P(axis),),
+                          (P(axis, None), P(axis, None)),
+                          _zeros(mesh, (n,), P(axis)))
+    shards_exp = np.zeros((n, n, pad), np.float32)
+    counts_exp = np.zeros((n, n), np.float32)
+    for src in range(n):
+        shards_exp[:, src, :src + 1] = src + 10.0
+        counts_exp[:, src] = src + 1
+    return (_check(shards, shards_exp.reshape(n, n * pad))
+            and _check(counts, counts_exp))
+
+
+def test_collective_gather(mesh: Mesh, axis: str = "data",
+                           root: int = 0) -> bool:
+    """Rooted gather: root sees every rank's value concatenated, non-root
+    ranks see zeros (ref: test_collective_gather,
+    comms/detail/test.hpp)."""
+    n = mesh.shape[axis]
+    comms = Comms(axis=axis, mesh=mesh)
+
+    def body(x):
+        mine = comms.get_rank().astype(jnp.float32)[None] + 5.0
+        return comms.gather(mine, root=root)[None]
+
+    out = _run(mesh, axis, body, (P(axis),), P(axis, None),
+               _zeros(mesh, (n,), P(axis)))
+    expect = np.zeros((n, n), np.float32)
+    expect[root] = np.arange(n, dtype=np.float32) + 5.0
+    return _check(out, expect)
+
+
 def test_collective_broadcast(mesh: Mesh, axis: str = "data", root: int = 0) -> bool:
     """Root's value must land on every rank (ref: test_collective_bcast)."""
     n = mesh.shape[axis]

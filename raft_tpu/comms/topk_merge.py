@@ -56,6 +56,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.sentinels import worst_value
 from raft_tpu.util.pow2 import is_pow2
 from raft_tpu.util.shard_map_compat import axis_size as _axis_size
 
@@ -247,9 +248,10 @@ def topk_merge(dist, idx, k: int, axis, select_min: bool = True,
     # f32 distance, everyone else the worst value, and a pmin/pmax
     # recovers the exact distance everywhere.
     owned = surv_i[:, :, None] == idx[:, None, :]        # (q, cap, kk)
-    local = jnp.min(jnp.where(owned, dist[:, None, :], jnp.inf), axis=2) \
+    worst = worst_value(select_min)
+    local = jnp.min(jnp.where(owned, dist[:, None, :], worst), axis=2) \
         if select_min else \
-        jnp.max(jnp.where(owned, dist[:, None, :], -jnp.inf), axis=2)
+        jnp.max(jnp.where(owned, dist[:, None, :], worst), axis=2)
     exact = lax.pmin(local, axis) if select_min else lax.pmax(local, axis)
     return _sorted_select(exact, surv_i, k_out, select_min)
 

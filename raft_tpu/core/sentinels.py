@@ -1,0 +1,57 @@
+"""The single definition of the merge/padding sentinel values.
+
+Ref: the reference's warp-select kernels pad candidate queues with a
+"dummy" worst key and an invalid index (select_warpsort.cuh `kDummy`,
+knn_merge_parts.cuh) — one convention every merge path agrees on.  Our
+analog: merge padding, dead-shard neutralization and empty-slot ids all
+use *these* values (``ci/analyze.py``'s ``sentinel`` check enforces
+that no merge-path module re-types the literals):
+
+* ``PAD_ID`` (= -1) — the id carried by padding / invalid candidate
+  slots.  Every merge engine ranks pad candidates last (worst distance
+  first; ties to lowest id never promote a pad id over a real one, as
+  real ids are >= 0).
+* :func:`worst_value` — the worst-possible distance for a selection
+  polarity (+inf when selecting minima, -inf for maxima), what
+  ``topk_merge``/``merge_parts``/``neutralize_dead`` pad with.
+* :func:`dummy_key_val` — dtype-aware variant (select_warpsort's
+  ``kDummy``): ±inf for floats, the extreme integer otherwise.
+
+Keep this module dependency-light (jnp only): comms, parallel, serve,
+matrix and neighbors all import it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: Id sentinel for padding / invalid / dead-shard candidate slots.
+PAD_ID = -1
+
+
+def worst_value(select_min: bool, dtype=None):
+    """The worst-possible float key for one selection polarity: +inf when
+    selecting minima (distances), -inf when selecting maxima (inner
+    product).  Returns a Python float (weak-typed in jnp expressions)
+    unless ``dtype`` pins it to a jnp scalar."""
+    value = float("inf") if select_min else float("-inf")
+    if dtype is None:
+        return value
+    return jnp.asarray(value, dtype)
+
+
+def pad_id(dtype=None):
+    """``PAD_ID`` as a Python int, or a jnp scalar when ``dtype`` is
+    given (e.g. to match an index array's int32/int64)."""
+    if dtype is None:
+        return PAD_ID
+    return jnp.asarray(PAD_ID, dtype)
+
+
+def dummy_key_val(dtype, select_min: bool):
+    """Padding sentinel for a key dtype (ref: select_warpsort's 'dummy'
+    = worst value): ±inf for floats, the dtype's extreme otherwise."""
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        return jnp.asarray(worst_value(select_min), dtype=dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if select_min else info.min, dtype=dtype)

@@ -40,6 +40,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array
+from raft_tpu.core.sentinels import PAD_ID, dummy_key_val, worst_value
 from raft_tpu.util.pow2 import ceildiv, round_up_safe
 from raft_tpu.util.pallas_compat import TPUCompilerParams
 from raft_tpu.core.nvtx import traced
@@ -69,11 +70,9 @@ def _to_descending_keys(v: jax.Array, select_min: bool) -> jax.Array:
 
 
 def _dummy_key_val(dtype, select_min: bool):
-    """Sentinel for padding (ref: select_warpsort 'dummy' = worst value)."""
-    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
-        return jnp.array(jnp.inf if select_min else -jnp.inf, dtype=dtype)
-    info = jnp.iinfo(dtype)
-    return jnp.array(info.max if select_min else info.min, dtype=dtype)
+    """Sentinel for padding (ref: select_warpsort 'dummy' = worst value;
+    the shared definition lives in core/sentinels.py)."""
+    return dummy_key_val(dtype, select_min)
 
 
 def _direct_top_k(values, k, select_min):
@@ -141,7 +140,7 @@ def _mextract_kernel(v_ref, outv_ref, outi_ref, *, n: int):
         vd, vi = carry
         w = v_ref[:, pl.ds(sub * _SUB, _SUB)].astype(jnp.float32)
         ids = j * _BT + sub * _SUB + col
-        w = jnp.where(ids < n, w, jnp.inf)
+        w = jnp.where(ids < n, w, worst_value(True))
 
         def body_t(t, c2):
             w, vd, vi = c2
@@ -149,7 +148,7 @@ def _mextract_kernel(v_ref, outv_ref, outi_ref, *, n: int):
             hit = w == cur
             sel = jnp.min(jnp.where(hit, ids, _I32MAX), axis=1,
                           keepdims=True)
-            w = jnp.where(ids == sel, jnp.inf, w)
+            w = jnp.where(ids == sel, worst_value(True), w)
             put = col128 == sub * _M + t
             vd = jnp.where(put, cur, vd)
             vi = jnp.where(put, sel, vi)
@@ -158,8 +157,8 @@ def _mextract_kernel(v_ref, outv_ref, outi_ref, *, n: int):
         _, vd, vi = jax.lax.fori_loop(0, _M, body_t, (w, vd, vi))
         return vd, vi
 
-    vd0 = jnp.full((bq, 128), jnp.inf, jnp.float32)
-    vi0 = jnp.full((bq, 128), -1, jnp.int32)
+    vd0 = jnp.full((bq, 128), worst_value(True), jnp.float32)
+    vi0 = jnp.full((bq, 128), PAD_ID, jnp.int32)
     vd, vi = jax.lax.fori_loop(0, _NSUB, body_sub, (vd0, vi0))
     outv_ref[:] = vd
     outi_ref[:] = vi
@@ -350,7 +349,7 @@ def select_k(
         pad = idx >= payload.shape[1]
         safe = jnp.minimum(idx, payload.shape[1] - 1)
         gathered = jnp.take_along_axis(payload, safe, axis=1)
-        idx = jnp.where(pad, jnp.asarray(-1, gathered.dtype), gathered)
+        idx = jnp.where(pad, jnp.asarray(PAD_ID, gathered.dtype), gathered)
     if squeeze:
         return sel[0], idx[0]
     return sel, idx

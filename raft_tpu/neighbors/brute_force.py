@@ -32,6 +32,7 @@ from jax import lax
 from raft_tpu.comms.topk_merge import merge_parts
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import as_array, validate_idx_dtype
+from raft_tpu.core.sentinels import PAD_ID, worst_value
 from raft_tpu.distance.distance_types import (
     DistanceType, resolve_metric, value_form_select_min)
 from raft_tpu.distance.pairwise import distance as pairwise_distance_fn
@@ -94,7 +95,7 @@ def _tiled_knn_l2(queries, db, k: int, sqrt: bool, tile_db: int, inner_is_l2: bo
     tiles = dbp.reshape(nb, tile_db, d)
     bad = valid.reshape(nb, tile_db)
 
-    worst = jnp.inf if inner_is_l2 else -jnp.inf
+    worst = worst_value(select_min=inner_is_l2)
 
     def body(carry, tile):
         best_d, best_i, base = carry
@@ -119,7 +120,7 @@ def _tiled_knn_l2(queries, db, k: int, sqrt: bool, tile_db: int, inner_is_l2: bo
 
     init = (
         jnp.full((m, k), worst, queries.dtype),
-        jnp.full((m, k), -1, jnp.int32),
+        jnp.full((m, k), PAD_ID, jnp.int32),
         jnp.int32(0),
     )
     (best_d, best_i, _), _ = lax.scan(body, init, (tiles, bad))
@@ -273,11 +274,14 @@ def knn(
         pi = pi.astype(idx_dtype)
         kk = pd.shape[1]
         if kk < k:  # pad small parts so merge shapes agree
-            worst = jnp.inf if value_form_select_min(metric) else -jnp.inf
+            worst = worst_value(value_form_select_min(metric))
             pd = jnp.concatenate(
                 [pd, jnp.full((pd.shape[0], k - kk), worst, pd.dtype)], axis=1)
+            # translations re-offset merged ids by ``base``; pre-subtract
+            # it so pad slots come out as the shared PAD_ID.
             pi = jnp.concatenate(
-                [pi, jnp.full((pi.shape[0], k - kk), -1 - base, pi.dtype)], axis=1)
+                [pi, jnp.full((pi.shape[0], k - kk), PAD_ID - base,
+                              pi.dtype)], axis=1)
         all_d.append(pd)
         all_i.append(pi)
         offsets.append(base)

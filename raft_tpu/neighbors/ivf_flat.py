@@ -126,6 +126,11 @@ class Index:
     list_sizes: jax.Array       # (n_lists,) int32
     adaptive_centers: bool = False
     conservative_memory_allocation: bool = False
+    # Monotonic content version, bumped by every extend — the serving
+    # layer's cache-invalidation key (serve/cache.py), same contract as
+    # the sharded indexes (parallel/ivf.py). Process-local: not
+    # serialized (a reload re-validates caches by construction).
+    epoch: int = 0
 
     def __post_init__(self):
         # Cross-tensor shape consistency at construction: a corrupted or
@@ -389,6 +394,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                 sums / cnt[:, None], centers)
         index.data, index.indices, index.list_sizes = data, ids, sizes
         index.centers = centers
+        index.epoch += 1      # serving caches must not outlive old contents
         index.reset_search_cache()
         return index
 
@@ -400,6 +406,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     index.data, index.indices, index.list_sizes = data, ids, sizes
     if index.adaptive_centers:
         index.centers = centers
+    index.epoch += 1          # serving caches must not outlive old contents
     index.reset_search_cache()  # occupancy changed
     return index
 

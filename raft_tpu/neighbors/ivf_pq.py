@@ -219,6 +219,11 @@ class Index:
     pq_bits: int = 8
     pq_dim: int = 0
     conservative_memory_allocation: bool = False
+    # Monotonic content version, bumped by every extend — the serving
+    # layer's cache-invalidation key (serve/cache.py), same contract as
+    # the sharded indexes (parallel/ivf.py). Process-local: not
+    # serialized (a reload re-validates caches by construction).
+    epoch: int = 0
     # Lazy bf16 reconstruction cache (n_lists, cap, rot_dim) backing the
     # recon-tier bucketed search engine; see reconstructed(). Not
     # serialized.
@@ -1053,6 +1058,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                          index.n_lists, min_cap)
         index.pq_codes = packed.astype(jnp.uint8)
         index.indices, index.list_sizes = ids, sizes
+        index.epoch += 1  # serving caches must not outlive old contents
         _invalidate_caches(index)
         return index
 
@@ -1060,6 +1066,7 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         index.pq_codes, index.indices, index.list_sizes, codes,
         new_indices, labels, index.conservative_memory_allocation)
     index.pq_codes, index.indices, index.list_sizes = store, ids, sizes
+    index.epoch += 1      # serving caches must not outlive old contents
     _invalidate_caches(index)
     return index
 

@@ -23,19 +23,41 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from raft_tpu.core.error import expects
+from raft_tpu.core.sentinels import pad_id, worst_value
 
 
-def check_live_mask(live_mask, n_dev: int) -> jax.Array:
+def check_live_mask(live_mask, n_dev: int, mesh=None) -> jax.Array:
     """Validate a per-shard liveness mask (host-side): bool (n_dev,),
     at least one live shard (zero coverage cannot serve anything —
     fail-hard there belongs to the caller's health policy, not inside a
-    compiled program). Shared by every sharded search entry point."""
+    compiled program). Shared by every sharded search entry point.
+    With ``mesh``, the mask is explicitly placed replicated — a declared
+    boundary transfer instead of an implicit one at jit dispatch (the
+    sanitizer lane's transfer guard rejects the latter)."""
     live = np.asarray(live_mask)
     expects(live.shape == (n_dev,),
             "live_mask must be shape (%s,), got %s", n_dev, live.shape)
     live = live.astype(bool)
     expects(bool(live.any()), "all shards dead: nothing to search")
+    if mesh is not None:
+        return jax.device_put(
+            jnp.asarray(live),
+            jax.sharding.NamedSharding(mesh, P()))
     return jnp.asarray(live)
+
+
+def replicated(mesh, x) -> jax.Array:
+    """Explicitly place ``x`` replicated over ``mesh`` — the declared
+    host->device (or device->device) boundary of every sharded search
+    call. A no-op when ``x`` already carries that sharding, so model
+    tensors placed once stay put; without it the jit dispatch performs
+    the same transfer implicitly on EVERY call (and the sanitizer
+    lane's ``jax.transfer_guard("disallow")`` rejects it)."""
+    sharding = jax.sharding.NamedSharding(mesh, P())
+    x = jnp.asarray(x)
+    if getattr(x, "sharding", None) == sharding:
+        return x
+    return jax.device_put(x, sharding)
 
 
 def local_alive(live, axis):
@@ -49,9 +71,8 @@ def neutralize_dead(dist, idx, alive, select_min: bool):
     (worst-possible distance, id -1) so every merge engine ranks them
     last — the ``merge_parts`` padding convention applied per shard.
     ``alive`` is this shard's scalar liveness (see :func:`local_alive`)."""
-    worst = jnp.asarray(jnp.inf if select_min else -jnp.inf, dist.dtype)
-    return (jnp.where(alive, dist, worst),
-            jnp.where(alive, idx, jnp.asarray(-1, idx.dtype)))
+    return (jnp.where(alive, dist, worst_value(select_min, dist.dtype)),
+            jnp.where(alive, idx, pad_id(idx.dtype)))
 
 
 def live_specs(has_live: bool):

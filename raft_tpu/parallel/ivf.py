@@ -42,6 +42,7 @@ from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
 from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import validate_idx_dtype
+from raft_tpu.core.sentinels import PAD_ID
 from raft_tpu.distance.distance_types import DistanceType
 from raft_tpu.neighbors import ivf_flat as _flat
 from raft_tpu.neighbors import ivf_pq as _pq
@@ -52,6 +53,7 @@ from raft_tpu.parallel.degraded import (
     local_alive,
     neutralize_dead,
     probed_coverage,
+    replicated,
 )
 from raft_tpu.util.pow2 import ceildiv, next_pow2
 from raft_tpu.util.shard_map_compat import shard_map
@@ -248,7 +250,11 @@ def sharded_ivf_flat_search(
     ``coverage`` (float32 (q,)) reports the per-query fraction of
     probed candidate rows searched. All-live results are bit-identical
     to the ``live_mask=None`` path."""
-    Q = _flat._as_float(_flat.as_array(queries))
+    Q = replicated(mesh, _flat._as_float(_flat.as_array(queries)))
+    # Model tensors place replicated ONCE (write-back): the un-placed
+    # single-device centers would otherwise re-transfer at every jit
+    # dispatch, implicitly.
+    index.centers = replicated(mesh, index.centers)
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     n_probes = min(params.n_probes, index.centers.shape[0])
     # Clamp by the GLOBAL capacity (n_dev shards merge their top-k), the
@@ -265,7 +271,7 @@ def sharded_ivf_flat_search(
         index.centers.shape[1], Q.shape[0], n_probes,
         index.indices.shape[1])
     live = (None if live_mask is None
-            else check_live_mask(live_mask, mesh.shape[index.axis]))
+            else check_live_mask(live_mask, mesh.shape[index.axis], mesh))
     return _sharded_flat_search_jit(
         index.data, index.indices, index.list_sizes, index.centers, Q,
         live, mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
@@ -332,9 +338,12 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
             >= index.list_sizes[:, :, None], sharding)
         centers_rot = jnp.matmul(index.centers, index.rotation_matrix.T,
                                  precision=lax.Precision.HIGHEST)
-        crot_p = permute_subspaces(centers_rot, index.pq_dim, index.pq_bits)
+        crot_p = replicated(
+            mesh, permute_subspaces(centers_rot, index.pq_dim,
+                                    index.pq_bits))
         lo, hi = book_tables(index.pq_centers, index.pq_bits)
-        index._scan_cache = (codesT, invalid, lo, hi, crot_p)
+        index._scan_cache = (codesT, invalid, replicated(mesh, lo),
+                             replicated(mesh, hi), crot_p)
     return index._scan_cache
 
 
@@ -453,7 +462,12 @@ def sharded_ivf_pq_search(
     exact-over-survivors results plus a third ``coverage`` (float32
     (q,)) output — the per-query fraction of probed candidate rows
     searched. All-live results are bit-identical to ``live_mask=None``."""
-    Q = _pq._as_float(_pq.as_array(queries))
+    Q = replicated(mesh, _pq._as_float(_pq.as_array(queries)))
+    # Replicated model tensors placed once (write-back) — see the flat
+    # entry point; without it every dispatch re-transfers implicitly.
+    index.centers = replicated(mesh, index.centers)
+    index.rotation_matrix = replicated(mesh, index.rotation_matrix)
+    index.pq_centers = replicated(mesh, index.pq_centers)
     expects(Q.shape[1] == index.centers.shape[1], "query dim mismatch")
     lut_dtype, internal_dtype = _pq.validate_search_dtypes(params)
     n_probes = min(params.n_probes, index.centers.shape[0])
@@ -465,7 +479,7 @@ def sharded_ivf_pq_search(
     engine = resolve_merge_engine(merge_engine, Q.shape[0], k,
                                   mesh.shape[index.axis])
     live = (None if live_mask is None
-            else check_live_mask(live_mask, mesh.shape[index.axis]))
+            else check_live_mask(live_mask, mesh.shape[index.axis], mesh))
     n_lists = index.indices.shape[1]
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
@@ -540,7 +554,7 @@ def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
                     + ((0, 0),) * (store.ndim - 3)), sharding)
         index.indices = jax.device_put(
             jnp.pad(index.indices, ((0, 0), (0, 0), (0, new_cap - cap)),
-                    constant_values=-1), sharding)
+                    constant_values=PAD_ID), sharding)
     st, id_, sz = _sharded_scatter_append(
         store, index.indices, index.list_sizes, pl, ni, lb)
     setattr(index, store_name, st)

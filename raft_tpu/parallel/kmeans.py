@@ -23,6 +23,7 @@ from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
 from raft_tpu.core.error import expects
+from raft_tpu.core.sentinels import worst_value
 from raft_tpu.distance.fused_l2_nn import fused_l2_nn_min_reduce
 
 
@@ -83,7 +84,7 @@ def sharded_kmeans_fit(
     k = centroids.shape[0]
     expects(X.shape[0] % mesh.shape[axis] == 0,
             "rows must divide the mesh axis (pad first)")
-    inertia = jnp.asarray(jnp.inf, X.dtype)
+    inertia = jnp.asarray(worst_value(True), X.dtype)
     for _ in range(n_iters):
         centroids, inertia = _sharded_em_step_jit(X, centroids, mesh=mesh,
                                                   axis=axis, k=k)

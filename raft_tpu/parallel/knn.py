@@ -43,6 +43,7 @@ from raft_tpu.parallel.degraded import (
     live_specs,
     local_alive,
     neutralize_dead,
+    replicated,
 )
 
 
@@ -89,7 +90,9 @@ def sharded_knn(
     are bit-identical to the ``live_mask=None`` path.
     """
     db = jnp.asarray(db)
-    queries = jnp.asarray(queries)
+    if getattr(db, "sharding", None) != NamedSharding(mesh, P(axis, None)):
+        db = shard_database(mesh, db, axis)   # declared placement, not an
+    queries = replicated(mesh, queries)       # implicit dispatch transfer
     n_dev = mesh.shape[axis]
     n, d = db.shape
     expects(n % n_dev == 0, "db rows must divide the mesh axis (pad first)")
@@ -97,7 +100,8 @@ def sharded_knn(
     kk = min(k, shard)
     tile = min(tile_db, shard)
     engine = resolve_merge_engine(merge_engine, queries.shape[0], k, n_dev)
-    live = None if live_mask is None else check_live_mask(live_mask, n_dev)
+    live = (None if live_mask is None
+            else check_live_mask(live_mask, n_dev, mesh))
     return _sharded_knn_jit(db, queries, live, mesh=mesh, axis=axis, k=k,
                             kk=kk, sqrt=sqrt, tile=tile, shard=shard,
                             engine=engine)

@@ -214,7 +214,8 @@ class BatchScheduler:
         return ticket
 
     def pending(self) -> int:
-        return len(self._queue)
+        with self._lock:   # len() is GIL-atomic, but the lock keeps the
+            return len(self._queue)   # read ordered against rebuilds
 
     def now(self) -> float:
         """The scheduler's clock (deadlines are absolute on THIS clock:
@@ -283,7 +284,7 @@ class BatchScheduler:
     def run_until_idle(self) -> int:
         """Drain the queue completely; returns requests completed."""
         total = 0
-        while self._queue:
+        while self.pending():
             total += self.flush()
         return total
 

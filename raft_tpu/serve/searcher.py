@@ -129,9 +129,9 @@ class Searcher:
     @property
     def epoch(self) -> int:
         """Monotonic index-content version — the cache-invalidation key.
-        Sharded IVF indexes carry their own counter (bumped by the
-        parallel extend paths even when called outside this facade);
-        brute-force / single-host extends count here."""
+        IVF indexes (single-host and sharded) carry their own counter,
+        bumped by every extend even when called outside this facade;
+        brute-force extends count in ``_base_epoch``."""
         return self._base_epoch + int(getattr(self._index, "epoch", 0))
 
     def add_invalidation_hook(
@@ -218,13 +218,17 @@ class Searcher:
                              monotonic=self._monotonic)
         else:
             out = attempt()
+        # jax.device_get, not np.asarray: the result pull is the DECLARED
+        # host boundary of the hot path, so it stays legal under the
+        # sanitizer lane's jax.transfer_guard("disallow") (tests/conftest)
+        # while any hidden implicit transfer inside the path still trips.
+        import jax
+
         if len(out) == 3:
-            d, i, cov = out
-            return SearchResult(np.asarray(d), np.asarray(i),
-                                np.asarray(cov), degraded=True)
-        d, i = out
-        return SearchResult(np.asarray(d), np.asarray(i),
-                            np.ones(q.shape[0], np.float32))
+            d, i, cov = jax.device_get(out)
+            return SearchResult(d, i, cov, degraded=True)
+        d, i = jax.device_get(out)
+        return SearchResult(d, i, np.ones(q.shape[0], np.float32))
 
     # -- lifecycle ---------------------------------------------------------
     def extend(self, new_vectors, new_indices=None) -> None:
@@ -264,8 +268,10 @@ class Searcher:
             from raft_tpu.neighbors import ivf_flat, ivf_pq
 
             mod = ivf_flat if self.kind == "ivf_flat" else ivf_pq
+            # extend bumps the Index's own .epoch (the counter this
+            # facade's ``epoch`` property reads) — no _base_epoch bump,
+            # or every extend would count twice.
             mod.extend(self._index, new_vectors, new_indices)
-            self._base_epoch += 1
         for hook in self._invalidation_hooks:
             hook()
 

@@ -72,17 +72,22 @@ def knn_graph(
             ips.append(ip)
         d = jnp.concatenate(dps)[:n]
         i = jnp.concatenate(ips)[:n]
-    rows = jnp.repeat(jnp.arange(n, dtype=jnp.int32), i.shape[1])
-    cols = i.reshape(-1)
-    vals = d.reshape(-1)
-    keep = np.asarray(rows != cols)
-    rows_h = np.asarray(rows)[keep]
-    cols_h = np.asarray(cols)[keep]
-    vals_h = np.asarray(vals)[keep]
-    # Trim to exactly k per row where possible (self-match removal leaves
-    # k edges; rows whose self wasn't in the list keep k+1 → drop worst).
-    return COO(jnp.asarray(rows_h), jnp.asarray(cols_h), jnp.asarray(vals_h),
-               (n, n))
+    # Self-edge removal stays on device (flagged by graft-analyze: the
+    # old boolean-mask compaction pulled rows/cols/vals to the host
+    # mid-pipeline and re-uploaded them). Candidates arrive distance-
+    # sorted per row; a stable argsort on the is-self flag pushes the
+    # (unique) self match to the last column while preserving distance
+    # order, and dropping that column leaves kk-1 = min(k, n-1) true
+    # neighbors per row — fixed shapes, no host sync. Rows whose self
+    # match fell outside the top-(k+1) shed their worst edge instead,
+    # which only ever removes the weakest of k+1 candidates.
+    rows0 = jnp.arange(n, dtype=jnp.int32)
+    is_self = (i == rows0[:, None]).astype(jnp.int8)
+    order = jnp.argsort(is_self, axis=1)       # stable: distance order kept
+    d = jnp.take_along_axis(d, order, axis=1)[:, : kk - 1]
+    i = jnp.take_along_axis(i, order, axis=1)[:, : kk - 1]
+    rows = jnp.repeat(rows0, kk - 1)
+    return COO(rows, i.reshape(-1), d.reshape(-1), (n, n))
 
 
 @functools.partial(jax.jit, static_argnums=(2,))

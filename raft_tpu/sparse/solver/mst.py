@@ -138,10 +138,13 @@ def mst(
     max_rounds = max(2, int(np.ceil(np.log2(max(n, 2)))) + 2)
     in_mst, _ = _boruvka(rows, cols, w_alt, n, max_rounds)
 
-    keep = np.asarray(in_mst)
-    src = np.asarray(rows)[keep]
-    dst = np.asarray(cols)[keep]
-    w = np.asarray(weights)[keep]
+    # The forest guarantee below is deliberately a host union-find
+    # (data-dependent edge count, O(V) scalar loop); one boundary pull
+    # of the Borůvka selection, not a hot path.
+    keep = np.asarray(in_mst)       # analyze: host-sync-ok (see above)
+    src = np.asarray(rows)[keep]    # analyze: host-sync-ok (see above)
+    dst = np.asarray(cols)[keep]    # analyze: host-sync-ok (see above)
+    w = np.asarray(weights)[keep]   # analyze: host-sync-ok (see above)
     # Forest guarantee: union-find over the selected edges (lightest first)
     # dedupes directed copies and drops any residual tie-induced cycle.
     parent = np.arange(n)

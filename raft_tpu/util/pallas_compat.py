@@ -1,3 +1,4 @@
+# analyze: cite-ok — pure environment shim, no reference analog.
 """Pallas TPU API names across jax versions.
 
 jax <= 0.4.x ships the Mosaic kernel options struct as

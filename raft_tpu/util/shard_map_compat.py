@@ -1,3 +1,4 @@
+# analyze: cite-ok — pure environment shim, no reference analog.
 """shard_map and axis machinery across jax versions.
 
 jax >= 0.8 promotes ``shard_map`` to ``jax.shard_map`` and renames the

@@ -35,6 +35,59 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+class SanitizerLane:
+    """Handle passed to ``@pytest.mark.sanitized`` tests (the runtime
+    cross-check of ci/analyze.py's static host-sync claim).
+
+    The whole test body runs under ``jax.transfer_guard("disallow")``:
+    any implicit host<->device transfer (e.g. a raw numpy operand
+    reaching a jitted dispatch, the dynamic face of a host sync) raises;
+    explicit boundary transfers (device_put / device_get / jnp.asarray)
+    stay legal. A :class:`~raft_tpu.serve.stats.CompileCounter` runs
+    alongside; at teardown the lane asserts ZERO compiles after the
+    test's last :meth:`mark_steady` call — warm up, call
+    ``lane.mark_steady()``, then drive steady-state traffic.
+    """
+
+    def __init__(self, counter):
+        self.counter = counter
+        self._baseline = 0
+
+    def mark_steady(self) -> None:
+        """Everything compiled so far was warmup; from here on any
+        compile fails the test."""
+        self._baseline = self.counter.count
+
+    @property
+    def steady_compiles(self) -> int:
+        return self.counter.count - self._baseline
+
+    def allow_transfers(self):
+        """Escape hatch for an intentional host boundary inside a
+        sanitized test (nested guard override)."""
+        return jax.transfer_guard("allow")
+
+
+@pytest.fixture(autouse=True)
+def sanitizer_lane(request):
+    """Autouse, marker-gated: wraps ``@pytest.mark.sanitized`` tests in
+    transfer_guard("disallow") + CompileCounter. Request it by name to
+    get the :class:`SanitizerLane` handle."""
+    if request.node.get_closest_marker("sanitized") is None:
+        yield None
+        return
+    from raft_tpu.serve.stats import CompileCounter
+
+    with CompileCounter() as counter:
+        lane = SanitizerLane(counter)
+        with jax.transfer_guard("disallow"):
+            yield lane
+        steady = lane.steady_compiles
+    assert steady == 0, (
+        f"sanitized test compiled {steady} XLA program(s) after "
+        f"mark_steady() — the steady-state hot path must not retrace")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

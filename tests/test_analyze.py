@@ -1,0 +1,537 @@
+"""graft-analyze (ci/analyze.py) acceptance suite.
+
+Per check: a seeded-violation fixture must be FLAGGED, the same code
+with an inline ``# analyze: <check>-ok`` waiver must be SILENT, and a
+clean spelling must be SILENT. Plus: call-graph reachability for the
+host-sync check (the violation lives in a helper module only reachable
+from a jitted entry point), the forwarder/factory shard_map patterns the
+real tree uses, a deterministic (barrier-seeded) runtime race showing
+the lost update the lock-discipline check prevents, and the merge
+acceptance criterion — the analyzer must be CLEAN on this repo.
+"""
+
+import importlib.util
+import pathlib
+import sys
+import textwrap
+import threading
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "graft_analyze", ROOT / "ci" / "analyze.py")
+ga = importlib.util.module_from_spec(_spec)
+sys.modules["graft_analyze"] = ga   # dataclasses need the module entry
+_spec.loader.exec_module(ga)
+
+
+def run(files, checks):
+    if isinstance(files, str):
+        files = {"raft_tpu/fx/mod.py": files}
+    files = {rel: textwrap.dedent(src) for rel, src in files.items()}
+    return ga.analyze_sources(files, checks=checks)
+
+
+def lines_of(findings, check):
+    return sorted(f.line for f in findings if f.check == check)
+
+
+# ---------------------------------------------------------------------------
+# Driver / waivers
+
+
+def test_repo_is_clean():
+    """THE acceptance criterion: all checks exit clean on the merged
+    tree (real findings were fixed or waived in-line)."""
+    findings = ga.analyze_repo(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_exit_codes_on_tmp_tree(tmp_path):
+    bad = tmp_path / "raft_tpu"
+    bad.mkdir()
+    (bad / "m.py").write_text('"""Doc. Ref: x."""\nX = 1 \n')
+    assert ga.main(["--root", str(tmp_path)]) == 1        # trailing ws
+    (bad / "m.py").write_text('"""Doc. Ref: x."""\nX = 1\n')
+    assert ga.main(["--root", str(tmp_path)]) == 0
+
+
+def test_waiver_covers_own_and_next_line():
+    src = (
+        '"""Doc."""\n'
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    # analyze: host-sync-ok (test waiver on comment line)\n"
+        "    a = np.asarray(x)\n"
+        "    b = np.asarray(x)  # analyze: host-sync-ok inline\n"
+        "    return a, b\n"
+    )
+    assert run(src, ["host-sync"]) == []
+
+
+def test_unknown_waiver_token_does_not_silence():
+    src = (
+        '"""Doc."""\n'
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.asarray(x)  # analyze: sentinel-ok (wrong check)\n"
+    )
+    assert lines_of(run(src, ["host-sync"]), "host-sync") == [6]
+
+
+# ---------------------------------------------------------------------------
+# style / cite (the absorbed check_style gate)
+
+
+def test_style_flags_and_waives():
+    src = '"""Doc."""\nX = 1 \n'
+    assert lines_of(run(src, ["style"]), "style") == [2]
+    # NOTE: trailing-ws can't literally be waived in-line (the waiver
+    # comment would end the line), so waiving uses a wildcard import.
+    src = '"""Doc."""\nfrom os.path import *\n'
+    assert lines_of(run(src, ["style"]), "style") == [2]
+    src = ('"""Doc."""\n'
+           "from os.path import *  # analyze: style-ok (api re-export)\n")
+    assert run(src, ["style"]) == []
+
+
+def test_cite_flags_and_waives():
+    assert lines_of(run('"""No citation."""\nX = 1\n', ["cite"]),
+                    "cite") == [1]
+    assert run('"""Doc. Ref: cpp/include/raft/thing.cuh."""\nX = 1\n',
+               ["cite"]) == []
+    assert run('# analyze: cite-ok — environment shim\n"""No cite."""\n',
+               ["cite"]) == []
+    # non-library trees are not under the citation convention
+    assert run({"tests/t.py": '"""No citation."""\nX = 1\n'},
+               ["cite"]) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync: traced context
+
+
+HOT = '''
+"""Doc."""
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def entry(x, flag):
+    if flag:                      # static arg: Python branching is fine
+        x = x + 1.0
+    if x is None:                 # identity test: fine
+        return x
+    y = jnp.sum(x)
+    {line}
+    return y
+'''
+
+
+@pytest.mark.parametrize("line,should_flag", [
+    ("y = jnp.asarray(np.float32(0.0)) + y", False),   # constant, no sync
+    ("y = float(y)", True),
+    ("y = y.item()", True),
+    ("y = np.asarray(y)", True),
+    ("y = bool(y > 0)", True),
+])
+def test_traced_host_sync_calls(line, should_flag):
+    found = run(HOT.format(line=line), ["host-sync"])
+    assert bool(found) == should_flag, [f.render() for f in found]
+
+
+def test_traced_branching_on_value_flags():
+    src = HOT.format(line="y = y + (1.0 if True else 2.0)").replace(
+        "    y = jnp.sum(x)", "    y = jnp.sum(x)\n    if y > 0:\n"
+                              "        y = -y")
+    found = run(src, ["host-sync"])
+    assert any("branching" in f.msg for f in found)
+
+
+def test_reachability_across_modules():
+    """The violation lives in a helper module, only hot because a jitted
+    entry point in another module reaches it through the call graph."""
+    files = {
+        "raft_tpu/fx/hot.py": '''
+            """Doc."""
+            import functools
+            import jax
+            from raft_tpu.fx.helper import leaky
+
+            @functools.partial(jax.jit, static_argnames=())
+            def entry(x):
+                return leaky(x)
+            ''',
+        "raft_tpu/fx/helper.py": '''
+            """Doc."""
+            import numpy as np
+
+            def leaky(v):
+                return np.asarray(v)
+            ''',
+    }
+    found = run(files, ["host-sync"])
+    assert [f.rel for f in found] == ["raft_tpu/fx/helper.py"]
+    # same helper with no hot caller: silent
+    del files["raft_tpu/fx/hot.py"]
+    assert run(files, ["host-sync"]) == []
+
+
+def test_shard_map_body_params_are_traced():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def consumer(mesh, x):
+            def body(v):
+                if v[0] > 0:
+                    return v
+                return -v
+            f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P("data"))
+            return f(x)
+        '''
+    found = run(src, ["host-sync"])
+    assert any("branching" in f.msg for f in found)
+
+
+# ---------------------------------------------------------------------------
+# host-sync: eager device->host->device round trips
+
+
+def test_round_trip_flagged_and_boundary_pull_clean():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def trip(x):
+            d = jnp.arange(x)
+            h = np.asarray(d)[::2]
+            return jnp.asarray(h)
+
+        def boundary(x):
+            d = jnp.arange(x)
+            return np.asarray(d)
+        '''
+    found = run(src, ["host-sync"])
+    assert lines_of(found, "host-sync") == [8]
+    assert "round trip" in found[0].msg
+
+
+def test_round_trip_waived():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        def trip(x):
+            d = jnp.arange(x)
+            h = np.asarray(d)[::2]  # analyze: host-sync-ok (intentional)
+            return jnp.asarray(h)
+        '''
+    assert run(src, ["host-sync"]) == []
+
+
+# ---------------------------------------------------------------------------
+# axis-name hygiene
+
+
+def test_collective_without_wrapper_flags():
+    src = '''
+        """Doc."""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def bad(x):
+            return lax.psum(x, "rows")
+        '''
+    found = run(src, ["axis-name"])
+    assert lines_of(found, "axis-name") == [8]
+    assert "shard_map" in found[0].msg
+
+
+def test_unbound_literal_axis_flags_and_bound_is_clean():
+    src = '''
+        """Doc."""
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def consumer(mesh, x):
+            def body(v):
+                return lax.psum(v, {axis!r})
+            f = shard_map(body, mesh=mesh, in_specs=(P("data"),),
+                          out_specs=P())
+            return f(x)
+        '''
+    found = run(src.format(axis="ghost"), ["axis-name"])
+    assert lines_of(found, "axis-name") == [9]
+    assert "'ghost'" in found[0].msg or "ghost" in found[0].msg
+    assert run(src.format(axis="data"), ["axis-name"]) == []
+
+
+def test_forwarder_and_factory_wrappers_are_understood():
+    """The real tree's comms_test._run forwarder and kmeans._em_body
+    factory shapes: collectives inside them must NOT be flagged."""
+    src = '''
+        """Doc."""
+        from jax import lax
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        def _run(mesh, fn, spec):
+            return shard_map(fn, mesh=mesh, in_specs=spec, out_specs=spec)
+
+        def _factory(axis):
+            def step(v):
+                return lax.psum(v, axis)
+            return step
+
+        def consumer(mesh, x):
+            def body(v):
+                return lax.pmax(v, "data")
+            out = _run(mesh, body, (P("data"),))(x)
+            f2 = shard_map(_factory("data"), mesh=mesh,
+                           in_specs=(P("data"),), out_specs=P())
+            return out, f2(x)
+        '''
+    assert run(src, ["axis-name"]) == []
+
+
+def test_collective_waiver():
+    src = '''
+        """Doc."""
+        import jax
+        from jax import lax
+
+        @jax.jit
+        def bad(x):
+            return lax.psum(x, "rows")  # analyze: axis-name-ok (docs demo)
+        '''
+    assert run(src, ["axis-name"]) == []
+
+
+# ---------------------------------------------------------------------------
+# epoch-bump discipline
+
+
+EPOCH = '''
+"""Doc."""
+
+def {name}(index, rows):
+{body}
+'''
+
+
+@pytest.mark.parametrize("body,should_flag", [
+    ("    index.data = rows\n    return index", True),
+    ("    index.data = rows\n    index.epoch += 1\n    return index",
+     False),
+    # early return before any mutation: clean
+    ("    if rows is None:\n        return index\n"
+     "    index.data = rows\n    index.epoch += 1\n    return index",
+     False),
+    # one branch mutates+bumps, the other only delegates: clean
+    ("    if rows is not None:\n        index.data = rows\n"
+     "        index.epoch += 1\n    return index", False),
+    # mutation on one branch without a bump: that path is flagged
+    ("    if rows is not None:\n        index.data = rows\n"
+     "    return index", True),
+    # dynamic setattr (the _sharded_extend shape) counts as mutation
+    ("    setattr(index, 'pq_codes', rows)\n    return index", True),
+    ("    setattr(index, 'pq_codes', rows)\n    index.epoch += 1\n"
+     "    return index", False),
+])
+def test_epoch_bump_paths(body, should_flag):
+    found = run(EPOCH.format(name="extend", body=body), ["epoch-bump"])
+    assert bool(found) == should_flag, [f.render() for f in found]
+
+
+def test_epoch_waiver_and_future_lifecycle_names():
+    """delete/upsert/compact (ROADMAP item 3) are covered by the same
+    mutation detection — no special-casing on the name 'extend'."""
+    body = "    index.data = rows\n    return index"
+    found = run(EPOCH.format(name="delete", body=body), ["epoch-bump"])
+    assert len(found) == 1
+    waived = ("    index.data = rows  # analyze: epoch-bump-ok (build)\n"
+              "    return index")
+    assert run(EPOCH.format(name="delete", body=waived),
+               ["epoch-bump"]) == []
+
+
+# ---------------------------------------------------------------------------
+# lock discipline
+
+
+RACY = '''
+"""Doc."""
+import threading
+
+
+class MiniScheduler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+
+    def submit(self, item, max_queue):
+{submit}
+
+    def _append(self, item):
+        self._queue.append(item)
+
+    def drain(self):
+        with self._lock:
+            out = list(self._queue)
+            self._queue = []
+        return out
+'''
+
+UNLOCKED = """\
+        if len(self._queue) >= max_queue:
+            raise OverflowError
+        self._queue.append(item)"""
+
+LOCKED = """\
+        with self._lock:
+            if len(self._queue) >= max_queue:
+                raise OverflowError
+            self._queue.append(item)"""
+
+
+def test_lock_discipline_flags_unlocked_access():
+    found = run(RACY.format(submit=UNLOCKED), ["lock-discipline"])
+    # the unlocked read AND the unlocked append, plus the helper that is
+    # never called under the lock
+    assert found and all(f.check == "lock-discipline" for f in found)
+    assert run(RACY.format(submit=LOCKED).replace(
+        "    def _append(self, item):\n"
+        "        self._queue.append(item)\n\n", ""),
+        ["lock-discipline"]) == []
+
+
+def test_lock_discipline_accepts_lock_held_private_helper():
+    src = RACY.format(submit="""\
+        with self._lock:
+            if len(self._queue) >= max_queue:
+                raise OverflowError
+            self._append(item)""")
+    assert run(src, ["lock-discipline"]) == []
+
+
+def test_seeded_race_demonstrates_the_bug_class():
+    """Runtime face of the static check: a barrier forces BOTH threads
+    through the read-check before either appends — the deterministic
+    interleaving the lock would forbid — and the max_queue=1 bound is
+    violated. The locked spelling under the identical schedule keeps
+    the bound. This is the race BatchScheduler.submit's lock prevents."""
+    class Racy:
+        def __init__(self, gate):
+            self._queue = []
+            self._lock = threading.Lock()
+            self._gate = gate
+
+        def submit_unlocked(self, item, max_queue):
+            n = len(self._queue)          # read ...
+            self._gate.wait(timeout=5)    # ... deterministic preemption
+            if n < max_queue:             # ... check against stale read
+                self._queue.append(item)
+
+        def submit_locked(self, item, max_queue):
+            with self._lock:              # read+check+append are atomic;
+                n = len(self._queue)      # the gate sits OUTSIDE the
+                if n < max_queue:         # critical section
+                    self._queue.append(item)
+            self._gate.wait(timeout=5)
+
+        def run(self, fn):
+            ts = [threading.Thread(target=fn, args=(i, 1))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return len(self._queue)
+
+    racy = Racy(threading.Barrier(2))
+    assert racy.run(racy.submit_unlocked) == 2   # bound 1 violated: race
+    safe = Racy(threading.Barrier(2))
+    assert safe.run(safe.submit_locked) == 1     # bound held
+
+    found = run(RACY.format(submit=UNLOCKED), ["lock-discipline"])
+    assert found, "the analyzer must flag exactly this shape"
+
+
+# ---------------------------------------------------------------------------
+# sentinel consistency
+
+
+def test_sentinel_literals_flagged_in_scope():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+
+        def pad(x):
+            d = jnp.full((4, 4), jnp.inf, jnp.float32)
+            i = jnp.full((4, 4), -1, jnp.int32)
+            return jnp.where(x, d, jnp.asarray(-1, jnp.int32)), i
+        '''
+    found = run({"raft_tpu/comms/pad.py": src}, ["sentinel"])
+    assert len(found) >= 3
+    # same literals outside the merge-path scope: silent
+    assert run({"raft_tpu/stats/pad.py": src}, ["sentinel"]) == []
+
+
+def test_sentinel_shared_definition_is_clean():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+        from raft_tpu.core.sentinels import PAD_ID, worst_value
+
+        def pad(x):
+            d = jnp.full((4, 4), worst_value(True), jnp.float32)
+            i = jnp.full((4, 4), PAD_ID, jnp.int32)
+            return d, i
+        '''
+    assert run({"raft_tpu/comms/pad.py": src}, ["sentinel"]) == []
+
+
+def test_sentinel_waiver():
+    src = '''
+        """Doc."""
+        import jax.numpy as jnp
+
+        def pad(x):
+            return jnp.full((4,), -1, jnp.int32)  # analyze: sentinel-ok
+        '''
+    assert run({"raft_tpu/comms/pad.py": src}, ["sentinel"]) == []
+
+
+# ---------------------------------------------------------------------------
+# the shared sentinel definitions themselves
+
+
+def test_sentinel_values():
+    import numpy as np
+
+    from raft_tpu.core import sentinels
+
+    assert sentinels.PAD_ID == -1
+    assert sentinels.worst_value(True) == float("inf")
+    assert sentinels.worst_value(False) == float("-inf")
+    assert float(sentinels.worst_value(True, np.float32)) == float("inf")
+    assert int(sentinels.pad_id(np.int32)) == -1
+    assert float(sentinels.dummy_key_val(np.float32, True)) == float("inf")
+    assert int(sentinels.dummy_key_val(np.int32, False)) == \
+        np.iinfo(np.int32).min

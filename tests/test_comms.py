@@ -36,6 +36,12 @@ class TestCollectives:
     def test_gatherv(self, mesh):
         assert comms_mod.test_collective_gatherv(mesh)
 
+    def test_allgatherv(self, mesh):
+        assert comms_mod.test_collective_allgatherv(mesh)
+
+    def test_gather(self, mesh):
+        assert comms_mod.test_collective_gather(mesh)
+
     def test_broadcast(self, mesh):
         assert comms_mod.test_collective_broadcast(mesh)
 

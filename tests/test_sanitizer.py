@@ -1,0 +1,139 @@
+"""Runtime sanitizer lane: the dynamic cross-check of ci/analyze.py.
+
+The static ``host-sync`` check claims the serve / sharded hot paths
+never host-sync or retrace in steady state. These tests PROVE it at
+runtime: ``@pytest.mark.sanitized`` (tests/conftest.py) wraps each test
+in ``jax.transfer_guard("disallow")`` — any implicit host<->device
+transfer raises — plus a :class:`CompileCounter`; after the test calls
+``lane.mark_steady()``, a single XLA compile fails the lane.
+
+CI runs these as their own lane (``ci/test_python.sh``): zero guarded
+transfers, zero steady-state compiles, exact results.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.parallel import shard_database, sharded_ivf_flat_build, \
+    sharded_ivf_flat_search, sharded_knn
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.serve import BatchPolicy, BatchScheduler, BucketGrid, \
+    ResultCache, Searcher, ServeStats, warmup
+
+N_DEV = 4
+DIM = 16
+N_DB = 256
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices())
+    assert devs.size >= N_DEV, "conftest must force >= 4 virtual devices"
+    return Mesh(devs[:N_DEV], ("data",))
+
+
+@pytest.fixture(scope="module")
+def db():
+    return np.random.default_rng(11).normal(
+        size=(N_DB, DIM)).astype(np.float32)
+
+
+def queries(rng, n):
+    return rng.normal(size=(n, DIM)).astype(np.float32)
+
+
+@pytest.mark.sanitized
+def test_sharded_knn_steady_state(mesh4, db, sanitizer_lane):
+    """Direct sharded brute-force hot path: after one warm call per
+    engine, fresh query VALUES (same shapes) must run with zero
+    transfers tripped and zero compiles — and stay exact."""
+    rng = np.random.default_rng(23)
+    placed = shard_database(mesh4, db)   # explicit pre-placement
+    engines = ("allgather", "ring", "ring_bf16")
+    for e in engines:                    # warmup trace per engine
+        sharded_knn(mesh4, placed, queries(rng, 8), 5, merge_engine=e)
+    sanitizer_lane.mark_steady()
+
+    q = queries(rng, 8)
+    ref_d, ref_i = None, None
+    for e in engines:
+        d, i = jax.device_get(
+            sharded_knn(mesh4, placed, q, 5, merge_engine=e))
+        if ref_d is None:
+            # truth from the already-compiled allgather trace
+            ref_d, ref_i = d, i
+        elif e == "ring":
+            np.testing.assert_array_equal(d, ref_d)
+            np.testing.assert_array_equal(i, ref_i)
+        else:                            # bf16 exchange: exact re-rank
+            assert np.isfinite(d).all()
+    assert sanitizer_lane.steady_compiles == 0
+
+
+@pytest.mark.sanitized
+def test_sharded_ivf_flat_steady_state(mesh4, db, sanitizer_lane):
+    """Sharded IVF-Flat hot path under the guard: probe-scan search over
+    pre-placed list tensors, steady state compile-free."""
+    rng = np.random.default_rng(29)
+    with sanitizer_lane.allow_transfers():   # builds are not a hot path
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+    sp = ivf_flat.SearchParams(n_probes=4)
+    # warm under the guard: even the FIRST search must only make
+    # declared transfers
+    sharded_ivf_flat_search(mesh4, sp, index, queries(rng, 8), 5)
+    sanitizer_lane.mark_steady()
+
+    d, i = jax.device_get(
+        sharded_ivf_flat_search(mesh4, sp, index, queries(rng, 8), 5))
+    assert d.shape == (8, 5) and (i >= 0).all()
+    assert sanitizer_lane.steady_compiles == 0
+
+
+@pytest.mark.sanitized
+def test_serve_scheduler_steady_state(mesh4, db, sanitizer_lane):
+    """The full serving path — admission, micro-batching, padding,
+    sharded search, cache write, result slicing — under the transfer
+    guard: a mixed in-grid stream after warmup must trip nothing and
+    compile nothing, and batched answers must match per-request truth."""
+    rng = np.random.default_rng(31)
+    searcher = Searcher.brute_force(db, mesh=mesh4)
+    grid = BucketGrid.pow2(16, k_grid=(5, 10))
+    warmup(searcher, grid)
+    sched = BatchScheduler(
+        searcher, grid, BatchPolicy(max_batch=16, max_wait=0.0),
+        cache=ResultCache(32), stats=ServeStats())
+    qs = [queries(rng, n) for n in (1, 3, 8, 16, 2, 5)]
+    # Per-request truth (raw, unbucketed shapes) compiles its own
+    # programs — reference computation, not the serving hot path.
+    placed = shard_database(mesh4, db)
+    refs = [jax.device_get(sharded_knn(mesh4, placed, q, 5)) for q in qs]
+    sanitizer_lane.mark_steady()
+
+    tickets = [sched.submit(q, 5) for q in qs]
+    sched.run_until_idle()
+    for (ref_d, ref_i), t in zip(refs, tickets):
+        res = t.result()
+        np.testing.assert_allclose(res.distances, ref_d, rtol=1e-6)
+        np.testing.assert_array_equal(res.indices, ref_i)
+    assert sanitizer_lane.steady_compiles == 0
+    sched.close()
+
+
+@pytest.mark.sanitized
+def test_guard_actually_trips_on_implicit_transfer(sanitizer_lane):
+    """The lane has teeth: an implicit numpy operand reaching a jitted
+    dispatch — the dynamic face of the host-sync bug class — raises
+    under the guard (and the escape hatch re-allows it)."""
+    f = jax.jit(lambda v: v + 1)
+    x = np.ones((4,), np.float32)
+    with sanitizer_lane.allow_transfers():
+        f(x)                                   # warm the trace
+    sanitizer_lane.mark_steady()
+    with pytest.raises(Exception, match="[Dd]isallow"):
+        f(x)                                   # implicit transfer: trips
+    with sanitizer_lane.allow_transfers():
+        np.testing.assert_array_equal(jax.device_get(f(x)), x + 1)
