@@ -578,6 +578,16 @@ def _family_serve():
     run(quick=False)
 
 
+def _family_lifecycle():
+    """Mutable-index lifecycle metrics (ISSUE 8): upsert churn
+    throughput, search QPS vs tombstone fraction, compaction pass cost,
+    and serve p99 with a compaction publish landing mid-stream. Body
+    lives in bench/lifecycle.py (shared with the tier-1 smoke test)."""
+    from bench.lifecycle import run
+
+    run(quick=False)
+
+
 def _family_sharded():
     """Merge-engine metrics for the sharded search paths (ISSUE 1): QPS +
     estimated per-device exchange bytes per engine (allgather | ring |
@@ -687,6 +697,7 @@ def main():
     if "--no-1m" not in sys.argv:
         _run_family(_family_sharded, "bench_sharded_error")
         _run_family(_family_serve, "bench_serve_error")
+        _run_family(_family_lifecycle, "bench_lifecycle_error")
         _run_family(_family_1m, "bench_1m_error")
         _run_family(_family_sift1m_u8, "bench_sift1m_error")
         _run_family(_family_4m, "bench_4m_error")

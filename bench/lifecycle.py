@@ -1,0 +1,131 @@
+"""Lifecycle bench family (ISSUE 8 satellite).
+
+Measures the write side of the serving story (raft_tpu/lifecycle),
+bench.py-style one-JSON-row-per-metric:
+
+* ``lifecycle_churn_rows_per_s`` — sustained upsert throughput
+  (tombstone + encode + scatter-append per batch, the steady churn a
+  live index absorbs).
+* ``lifecycle_search_qps_tombstoned`` — search QPS at several tombstone
+  fractions (one row each, ``frac`` in the extras): the masked scan
+  must not fall off a cliff as deletes accumulate, because the mask
+  rides the same invalid lane as padding.
+* ``lifecycle_compact_s`` — one full reclamation pass (copy-on-write
+  repack), with the reclaimed slot count in the extras.
+* ``lifecycle_serve_p99_ms`` — scheduler p99 latency over a request
+  stream, measured for a quiet stream and for one with a compaction
+  publish landing mid-stream (``while_compacting`` in the extras): the
+  snapshot-swap must not spike tail latency.
+
+``quick=True`` is the CI smoke shape (tiny db, short stream; tier-1
+runs it via tests/test_lifecycle.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def run(quick: bool = False) -> None:
+    from raft_tpu.lifecycle import (CompactionPolicy, compact, delete,
+                                    upsert)
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve import (BatchPolicy, BatchScheduler, BucketGrid,
+                                Searcher, warmup)
+
+    rng = np.random.default_rng(8)
+    if quick:
+        n, d, n_lists, n_probes = 2048, 16, 8, 8
+        churn_rounds, churn_batch = 4, 16
+        q_rows, search_reps, n_requests = 32, 3, 24
+    else:
+        n, d, n_lists, n_probes = 262_144, 64, 256, 32
+        churn_rounds, churn_batch = 32, 256
+        q_rows, search_reps, n_requests = 256, 10, 400
+
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    q = rng.normal(size=(q_rows, d)).astype(np.float32)
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=5)
+    sp = ivf_flat.SearchParams(n_probes=n_probes, engine="scan")
+
+    # -- churn throughput: steady upsert batches over existing ids.
+    index = ivf_flat.build(params, db)
+    t0 = time.perf_counter()
+    for r in range(churn_rounds):
+        ids = (np.arange(churn_batch) + r * churn_batch) % n
+        upsert(index,
+               rng.normal(size=(churn_batch, d)).astype(np.float32), ids)
+    sec = time.perf_counter() - t0
+    _emit("lifecycle_churn_rows_per_s", churn_rounds * churn_batch / sec,
+          "rows/s", batch=churn_batch, rounds=churn_rounds, n_db=n, dim=d)
+
+    # -- QPS vs tombstone fraction (fresh index; masked trace warm).
+    index = ivf_flat.build(params, db)
+    done = 0
+    for frac in (0.0, 0.25, 0.5):
+        target = int(frac * n)
+        if target > done:
+            delete(index, np.arange(done, target))
+            done = target
+        d_, i_ = ivf_flat.search(sp, index, q, 10)   # warm this trace
+        np.asarray(d_)
+        t0 = time.perf_counter()
+        for _ in range(search_reps):
+            d_, i_ = ivf_flat.search(sp, index, q, 10)
+        np.asarray(d_)
+        sec = (time.perf_counter() - t0) / search_reps
+        _emit("lifecycle_search_qps_tombstoned", q_rows / sec, "qps",
+              frac=frac, n_db=n, dim=d, n_probes=n_probes)
+
+    # -- one reclamation pass (copy-on-write repack).
+    t0 = time.perf_counter()
+    new, rep = compact(index, CompactionPolicy(shrink_capacity=True))
+    sec = time.perf_counter() - t0
+    _emit("lifecycle_compact_s", sec, "s",
+          reclaimed=rep.reclaimed_slots, live=rep.live_rows,
+          cap_before=rep.cap_before, cap_after=rep.cap_after)
+
+    # -- serve p99 with and without a compaction publish mid-stream.
+    def serve_p99(searcher, inject_compaction: bool) -> float:
+        grid = BucketGrid.pow2(8, k_grid=(10,))
+        warmup(searcher, grid)
+        sched = BatchScheduler(searcher, grid,
+                               BatchPolicy(max_batch=8, max_wait=0.0,
+                                           max_queue=4 * n_requests))
+        for i in range(n_requests):
+            if inject_compaction and i == n_requests // 2:
+                searcher.compact()             # publish lands mid-stream
+            t = sched.submit(
+                rng.normal(size=(4, d)).astype(np.float32), 10)
+            sched.run_until_idle()
+            t.result()
+        snap = sched.stats.snapshot()
+        sched.close()
+        return max(row.get("latency_p99", 0.0)
+                   for row in snap["buckets"].values())
+
+    quiet = Searcher.ivf_flat(ivf_flat.build(params, db), sp)
+    _emit("lifecycle_serve_p99_ms", 1e3 * serve_p99(quiet, False), "ms",
+          while_compacting=False, n_requests=n_requests)
+    busy_index = ivf_flat.build(params, db)
+    delete(busy_index, np.arange(n // 4))
+    busy = Searcher.ivf_flat(busy_index, sp)
+    busy.search(rng.normal(size=(8, d)).astype(np.float32), 10)
+    _emit("lifecycle_serve_p99_ms", 1e3 * serve_p99(busy, True), "ms",
+          while_compacting=True, n_requests=n_requests)
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

@@ -32,9 +32,13 @@ axis-name       ppermute/psum/pmax/axis_index/... must run under an
                 somewhere in the tree (the bug class
                 util/shard_map_compat papers over).
 epoch-bump      any function mutating index storage (data / indices /
-                list_sizes / pq_codes / _db, incl. setattr) must bump
-                an ``.epoch`` counter on every return path after the
+                list_sizes / pq_codes / _db / the lifecycle tombstone
+                mask ``deleted``, incl. setattr) must bump an
+                ``.epoch`` counter on every return path after the
                 mutation — or ResultCache serves stale answers.
+                Tombstone-mask writes and list_sizes rewrites count
+                because they change which rows answer queries exactly
+                like a row write does.
 lock-discipline classes owning a threading.Lock may touch their
                 container state (queue, dicts, deques) only inside
                 ``with self._lock`` — a static race detector for the
@@ -94,11 +98,18 @@ SENTINEL_SCOPE = (
     "raft_tpu/comms/",
     "raft_tpu/parallel/",
     "raft_tpu/serve/",
+    "raft_tpu/lifecycle/",
     "raft_tpu/neighbors/brute_force.py",
     "raft_tpu/matrix/select_k.py",
 )
 
-STORAGE_ATTRS = {"data", "indices", "list_sizes", "pq_codes", "_db"}
+# Index-content mutations that must bump .epoch on every return path.
+# "deleted" is the lifecycle tombstone mask (a mask write changes which
+# rows answer queries exactly like a row write); compaction publishes
+# construct a NEW index (copy-on-write) so they carry the bump in the
+# constructor instead of tripping this set.
+STORAGE_ATTRS = {"data", "indices", "list_sizes", "pq_codes", "_db",
+                 "deleted"}
 STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "sharding",
                 "weak_type", "nbytes"}
 SYNC_METHODS = {"item", "tolist", "block_until_ready"}

@@ -12,7 +12,9 @@
 #   * chaos:  the deterministic fault-injection lane
 #     (raft_tpu/testing/chaos.py harness; seeded, no wall-clock
 #     randomness, so a CI failure replays bit-for-bit locally with
-#     `pytest -m chaos`);
+#     `pytest -m chaos`) — includes the lifecycle races: seeded
+#     delete/upsert/compaction interleavings against live serving and
+#     the failed-compaction-publishes-nothing pre_publish fault;
 #   * sanitize: the runtime cross-check of the analyzer's host-sync
 #     claim — marked hot-path tests re-run in isolation under
 #     jax.transfer_guard("disallow") + CompileCounter (zero guarded

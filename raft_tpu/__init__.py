@@ -43,6 +43,10 @@ Layer map (mirrors SURVEY.md §1, re-architected TPU-first):
               shape-bucketed compilation, dynamic micro-batching
               scheduler, exact-query result cache, deadline-aware
               degraded serving (docs/serving.md)
+  lifecycle — the write side of the serving story: tombstone delete,
+              upsert, background compaction under snapshot epochs
+              (ref: FreshDiskANN/Milvus streaming-update pattern;
+              docs/index_lifecycle.md)
   ops       — Pallas TPU kernels for the hot paths (select_k, fused L2 NN,
               PQ-LUT scan) (ref: hand-tiled CUDA kernels in detail/)
 """

@@ -1,0 +1,41 @@
+"""Mutable index lifecycle: tombstone delete, upsert, compaction.
+
+Ref: FreshDiskANN (arXiv:2105.09613) / the Milvus streaming-update
+design (PAPERS.md) — production ANN systems mutate via a tombstone-now /
+consolidate-later split; RAFT itself stops at ``ivf_flat::extend``
+(detail/ivf_flat_build.cuh:159).  This package is the write side that
+turns the read-mostly serving stack (raft_tpu/serve) into a database:
+
+* :func:`delete` — tombstone rows by id.  Deleted slots neutralize at
+  scoring through the same per-slot validity mask that hides
+  below-fill padding, so results are exact over the survivors
+  immediately — no compaction needed for correctness, no recompile per
+  delete (the mask is a traced operand, the ``live_mask`` contract).
+* :func:`upsert` — tombstone + extend under one epoch bump, so no
+  reader ever observes the half-applied state as current.
+* :func:`compact` / :class:`Compactor` — the background pass that
+  reclaims tombstoned slots (and, for IVF-Flat, splits overfull lists
+  and reclusters drifted ones), publishing a copy-on-write successor
+  index at ``epoch + 1``: in-flight batches and cached results keep
+  their pre-compaction snapshot (snapshot-at-dispatch semantics).
+
+See docs/index_lifecycle.md.
+"""
+
+from raft_tpu.lifecycle.delete import (
+    delete,
+    enable_tombstones,
+    tombstone_frac,
+    upsert,
+)
+from raft_tpu.lifecycle.compact import (
+    CompactionPolicy,
+    CompactionReport,
+    Compactor,
+    compact,
+)
+
+__all__ = [
+    "delete", "upsert", "enable_tombstones", "tombstone_frac",
+    "compact", "CompactionPolicy", "CompactionReport", "Compactor",
+]

@@ -1,0 +1,440 @@
+"""Background compaction: reclaim tombstones, split hot lists, recluster.
+
+Ref: FreshDiskANN's StreamingMerge (arXiv:2105.09613) — deletes
+accumulate as tombstones and a background consolidation pass rewrites
+the affected structure; RAFT's ``adaptive_centers``
+(ivf_flat_types.hpp:53-58) drifts centers but never re-balances lists.
+A compaction pass here:
+
+1. **reclaims** tombstoned slots — live rows repack contiguously per
+   list (relative order preserved, so pure reclamation leaves search
+   results bit-identical);
+2. **splits** IVF-Flat lists whose live occupancy exceeds
+   ``split_above`` × the mean (2-means on the list's members; the list
+   keeps one child center, the other appends — ``n_lists`` grows);
+3. **reclusters** IVF-Flat lists whose center drifted
+   ``drift_threshold`` × the median nearest-center gap away from the
+   live-member mean: the center snaps to the mean and all live rows
+   re-assign to their nearest center.
+
+Publication is COPY-ON-WRITE at the index level: the pass builds a
+successor index at ``epoch + 1`` and the caller (``Searcher.compact``)
+swaps one reference.  In-flight batches and cached results computed
+against the predecessor stay internally consistent
+(snapshot-at-dispatch), and their cache entries die with the old epoch.
+A pass that fails mid-way publishes nothing — the predecessor index is
+never touched.
+
+IVF-PQ stores residual codes relative to each list's center, so moving
+a row between lists would need re-encoding against the source vectors;
+PQ (and sharded) compaction therefore reclaims only — split/recluster
+requests are ignored with a warning.
+
+``shrink_capacity=False`` (the default) keeps the list-tensor shapes
+fixed so post-compaction serving reuses the warmed traces (zero
+steady-state compiles — the shape-stability contract of
+serve/bucketing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.cluster import kmeans_balanced
+from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import logger
+from raft_tpu.core.sentinels import PAD_ID, worst_value
+from raft_tpu.lifecycle.delete import _check_index, _is_sharded
+from raft_tpu.neighbors import ivf_flat as _flat
+from raft_tpu.neighbors import ivf_pq as _pq
+from raft_tpu.parallel.ivf import ShardedIvfPq
+
+
+@dataclass(frozen=True)
+class CompactionPolicy:
+    """Knobs of one compaction pass (docs/index_lifecycle.md).
+
+    ``trigger_frac`` — :class:`Compactor` runs a pass once this fraction
+    of stored slots is tombstoned.  ``shrink_capacity`` — False keeps
+    the per-list capacity (serving never recompiles after the publish);
+    True re-sizes to the live maximum (reclaims HBM, retraces once).
+    ``split_above`` — IVF-Flat only: split lists with live occupancy
+    above this multiple of the mean (None = off).  ``drift_threshold``
+    — IVF-Flat only: recluster lists whose center sits further than
+    this multiple of the median nearest-center gap from their
+    live-member mean (None = off).
+    """
+
+    trigger_frac: float = 0.25
+    shrink_capacity: bool = False
+    split_above: Optional[float] = None
+    drift_threshold: Optional[float] = None
+    min_split_rows: int = 16
+
+    def __post_init__(self):
+        expects(0.0 < self.trigger_frac <= 1.0,
+                "trigger_frac must be in (0, 1], got %s", self.trigger_frac)
+        expects(self.split_above is None or self.split_above > 1.0,
+                "split_above must be > 1 (a multiple of the mean load)")
+        expects(self.drift_threshold is None or self.drift_threshold > 0,
+                "drift_threshold must be > 0")
+
+
+@dataclass(frozen=True)
+class CompactionReport:
+    """What one published pass did (telemetry surface)."""
+
+    reclaimed_slots: int
+    live_rows: int
+    lists_split: int
+    lists_reclustered: int
+    n_lists_before: int
+    n_lists_after: int
+    cap_before: int
+    cap_after: int
+    epoch: int            # the successor index's epoch
+
+
+def _repack(flat_rows, labels, flat_ids, n_lists: int, min_cap: int):
+    """Scatter rows back into capacity-padded lists; rows labeled
+    ``n_lists`` (tombstoned / padding slots) drop out of the scatter
+    explicitly.  Stable over the flattened slot order, so pure
+    reclamation preserves each list's relative row order.  One scalar
+    capacity readback, like extend's growth check."""
+    labels = labels.astype(jnp.int32)
+    counts = jnp.bincount(labels, length=n_lists)
+    cap = int(max(int(jnp.max(counts)), 1, min_cap))
+    order = jnp.argsort(labels, stable=True)
+    sl = labels[order]
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
+    pos = (jnp.arange(labels.shape[0], dtype=jnp.int32)
+           - offsets[jnp.minimum(sl, n_lists - 1)].astype(jnp.int32))
+    store = jnp.zeros((n_lists, cap) + flat_rows.shape[1:],
+                      flat_rows.dtype)
+    ids = jnp.full((n_lists, cap), PAD_ID, flat_ids.dtype)
+    store = store.at[sl, pos].set(flat_rows[order], mode="drop")
+    ids = ids.at[sl, pos].set(flat_ids[order], mode="drop")
+    return store, ids, counts.astype(jnp.int32), cap
+
+
+def _live_slots(index, sizes, deleted):
+    """Per-slot liveness (below the fill line AND not tombstoned)."""
+    cap = index.indices.shape[-1]
+    slot = jnp.arange(cap, dtype=jnp.int32)
+    live = slot < sizes[..., None]
+    if deleted is not None:
+        live &= ~deleted
+    return live
+
+
+def _reclaim_labels(live, n_lists: int):
+    """Flattened repack labels for pure reclamation: each live slot
+    keeps its own list, dead slots label ``n_lists`` (dropped)."""
+    lists = jnp.arange(n_lists, dtype=jnp.int32)[:, None]
+    return jnp.where(live, lists, n_lists).reshape(-1)
+
+
+def _dense_live(store, indices, live):
+    """Gather live rows densely (original slot order).  One scalar
+    count readback sizes the gather."""
+    flat_live = live.reshape(-1)
+    n_live = int(jnp.sum(flat_live))
+    order = jnp.argsort(~flat_live, stable=True)[:max(n_live, 1)]
+    rows = store.reshape((-1,) + store.shape[2:])[order]
+    ids = indices.reshape(-1)[order]
+    return rows, ids, n_live
+
+
+def _split_two(rows):
+    """Split one list's members into two child centers by the median of
+    their principal-direction projection — deterministic and ~50/50 by
+    construction, where a 2-means on a tight hot blob can park one
+    child on a handful of outliers and leave the load unsplit (the
+    failure FreshDiskANN's split avoids the same way).  The children
+    straddle the median plane, so the global nearest-center relabel
+    reproduces the balanced cut."""
+    mean = jnp.mean(rows, axis=0)
+    X = rows - mean
+    v = jnp.ones((rows.shape[1],), rows.dtype)
+    for _ in range(8):                       # power iteration on X^T X
+        v = X.T @ (X @ v)
+        v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
+    proj = X @ v
+    left = (proj <= jnp.median(proj))[:, None].astype(rows.dtype)
+    n_left = jnp.maximum(jnp.sum(left), 1.0)
+    n_right = jnp.maximum(rows.shape[0] - jnp.sum(left), 1.0)
+    c0 = jnp.sum(rows * left, axis=0) / n_left
+    c1 = jnp.sum(rows * (1.0 - left), axis=0) / n_right
+    return c0, c1
+
+
+def _flat_model_pass(index, policy, live):
+    """Split + recluster for IVF-Flat: returns ``(centers, n_split,
+    n_reclustered)`` and, when the model changed, the dense live rows to
+    relabel against the new centers."""
+    centers = index.centers
+    n_lists = index.n_lists
+    dataf = _flat._as_float(index.data)
+    livef = live.astype(dataf.dtype)
+    cnt = jnp.sum(livef, axis=1)                         # (n_lists,)
+    n_reclustered = 0
+    changed = False
+
+    if policy.drift_threshold is not None and n_lists > 1:
+        sums = jnp.einsum("lc,lcd->ld", livef, dataf)
+        means = sums / jnp.maximum(cnt, 1.0)[:, None]
+        drift = jnp.linalg.norm(centers - means, axis=1)
+        cd = jnp.linalg.norm(centers[:, None] - centers[None, :], axis=2)
+        # Self-distance ranks last in the nearest-center min (the same
+        # worst-key convention the merge paths use).
+        cd = jnp.where(jnp.eye(n_lists, dtype=bool),
+                       worst_value(True, cd.dtype), cd)
+        scale = jnp.median(jnp.min(cd, axis=1))
+        drifted = (drift > policy.drift_threshold * scale) & (cnt > 0)
+        n_reclustered = int(jnp.sum(drifted))
+        if n_reclustered:
+            centers = jnp.where(drifted[:, None], means, centers)
+            changed = True
+
+    rows = ids = None
+    n_split = 0
+    if policy.split_above is not None or changed:
+        rows, ids, n_live = _dense_live(index.data, index.indices, live)
+        rowsf = _flat._as_float(rows)
+        if policy.split_above is not None and n_live:
+            kb = KMeansBalancedParams(metric=index.metric)
+            labels = kmeans_balanced.predict(kb, centers, rowsf)
+            # Host decision of which lists to split: a per-list 2-means
+            # needs each list's own rows as a dense host-sized slice.
+            counts = np.asarray(  # analyze: host-sync-ok (background pass)
+                jnp.bincount(labels, length=centers.shape[0]))
+            mean_live = max(1.0, n_live / centers.shape[0])
+            hot = np.flatnonzero(
+                (counts > policy.split_above * mean_live)
+                & (counts >= policy.min_split_rows))
+            lab_h = np.asarray(labels)  # analyze: host-sync-ok (background pass)
+            for l in hot.tolist():
+                members = rowsf[np.flatnonzero(lab_h == l)]
+                c0, c1 = _split_two(members)
+                centers = jnp.concatenate(
+                    [centers.at[l].set(c0), c1[None, :]])
+            n_split = int(hot.size)
+            changed = changed or n_split > 0
+    return centers, changed, n_split, n_reclustered, rows, ids
+
+
+def _compact_flat(index: "_flat.Index", policy: CompactionPolicy):
+    live = _live_slots(index, index.list_sizes, index.deleted)
+    cap = index.data.shape[1]
+    min_cap = 0 if policy.shrink_capacity else cap
+    centers, changed, n_split, n_recl, rows, ids = _flat_model_pass(
+        index, policy, live)
+    if changed:
+        labels = kmeans_balanced.predict(
+            KMeansBalancedParams(metric=index.metric), centers,
+            _flat._as_float(rows))
+        data, idx, sizes, new_cap = _repack(
+            rows.astype(index.data.dtype), labels, ids,
+            centers.shape[0], min_cap)
+    else:
+        labels = _reclaim_labels(live, index.n_lists)
+        data, idx, sizes, new_cap = _repack(
+            index.data.reshape((-1,) + index.data.shape[2:]), labels,
+            index.indices.reshape(-1), index.n_lists, min_cap)
+    new = dataclasses.replace(
+        index, centers=centers, data=data, indices=idx, list_sizes=sizes,
+        deleted=None, n_deleted=0, epoch=index.epoch + 1)
+    return new, n_split, n_recl, cap, new_cap
+
+
+def _compact_pq(index: "_pq.Index", policy: CompactionPolicy):
+    _warn_model_pass(policy, "IVF-PQ")
+    live = _live_slots(index, index.list_sizes, index.deleted)
+    cap = index.pq_codes.shape[1]
+    min_cap = 0 if policy.shrink_capacity else cap
+    labels = _reclaim_labels(live, index.n_lists)
+    codes, idx, sizes, new_cap = _repack(
+        index.pq_codes.reshape((-1,) + index.pq_codes.shape[2:]), labels,
+        index.indices.reshape(-1), index.n_lists, min_cap)
+    new = dataclasses.replace(
+        index, pq_codes=codes, indices=idx, list_sizes=sizes,
+        deleted=None, n_deleted=0, epoch=index.epoch + 1,
+        _recon=None, _scan_ops=None)   # slot layout moved: decode caches die
+    return new, cap, new_cap
+
+
+def _compact_sharded(mesh, index, policy: CompactionPolicy):
+    """Per-shard reclamation at one common capacity (the shard tensors
+    stay stacked over the mesh axis)."""
+    _warn_model_pass(policy, "sharded indexes")
+    is_pq = isinstance(index, ShardedIvfPq)
+    store = index.pq_codes if is_pq else index.data
+    n_dev, n_lists, cap = index.indices.shape
+    live = _live_slots(index, index.list_sizes, index.deleted)
+    counts = jnp.sum(live, axis=2)                    # (n_dev, n_lists)
+    common = cap if not policy.shrink_capacity \
+        else max(int(jnp.max(counts)), 1)
+    packed = []
+    for s in range(n_dev):
+        labels = _reclaim_labels(live[s], n_lists)
+        packed.append(_repack(
+            store[s].reshape((-1,) + store.shape[3:]), labels,
+            index.indices[s].reshape(-1), n_lists, common))
+    sharding = NamedSharding(mesh, P(index.axis))
+    st = jax.device_put(jnp.stack([p[0] for p in packed]), sharding)
+    idx = jax.device_put(jnp.stack([p[1] for p in packed]), sharding)
+    sizes = jax.device_put(jnp.stack([p[2] for p in packed]), sharding)
+    fields = dict(indices=idx, list_sizes=sizes, deleted=None,
+                  n_deleted=0, epoch=index.epoch + 1)
+    if is_pq:
+        fields.update(pq_codes=st, _scan_cache=None)
+    else:
+        fields.update(data=st)
+    return dataclasses.replace(index, **fields), cap, packed[0][3]
+
+
+def _warn_model_pass(policy: CompactionPolicy, what: str) -> None:
+    if policy.split_above is not None or policy.drift_threshold is not None:
+        logger.warning(
+            "split/recluster are IVF-Flat single-host passes (PQ codes "
+            "are residuals against their list's center and cannot move "
+            "lists without re-encoding) — ignored for %s", what)
+
+
+def compact(index, policy: Optional[CompactionPolicy] = None, mesh=None):
+    """Run one compaction pass; returns ``(new_index, report)`` — a
+    copy-on-write successor at ``epoch + 1`` — or ``(index, None)`` when
+    there is nothing to do (no tombstones and no model pass requested).
+    The input index is NEVER mutated: callers publish by swapping the
+    reference (``Searcher.compact`` does, atomically under its mutation
+    lock), so a pass that raises publishes nothing."""
+    policy = policy or CompactionPolicy()
+    _check_index(index, mesh)
+    wants_model = (policy.split_above is not None
+                   or policy.drift_threshold is not None)
+    if index.n_deleted == 0 and not wants_model and not policy.shrink_capacity:
+        return index, None
+    reclaimed = index.n_deleted
+    n_split = n_recl = 0
+    if _is_sharded(index):
+        new, cap, new_cap = _compact_sharded(mesh, index, policy)
+        n_lists_after = new.indices.shape[1]
+    elif isinstance(index, _pq.Index):
+        new, cap, new_cap = _compact_pq(index, policy)
+        n_lists_after = new.n_lists
+    else:
+        new, n_split, n_recl, cap, new_cap = _compact_flat(index, policy)
+        n_lists_after = new.n_lists
+    report = CompactionReport(
+        reclaimed_slots=reclaimed,
+        live_rows=int(jnp.sum(new.list_sizes)),
+        lists_split=n_split,
+        lists_reclustered=n_recl,
+        n_lists_before=index.indices.shape[-2],
+        n_lists_after=n_lists_after,
+        cap_before=cap,
+        cap_after=new_cap,
+        epoch=new.epoch,
+    )
+    return new, report
+
+
+class Compactor:
+    """Threshold-driven compaction driver over a
+    :class:`~raft_tpu.serve.searcher.Searcher`.
+
+    Deterministic surface first: tests (and schedulers that own their
+    cadence) call :meth:`run_once`; :meth:`start` spawns the optional
+    daemon loop for wall-clock deployments (injectable ``sleep`` so the
+    loop is still testable).  ``pre_publish`` is the chaos injection
+    point (``ChaosMonkey.hook``): it runs after the successor index is
+    built but before the swap, so an injected fault proves the
+    no-partial-publish contract — the serving index and its epoch are
+    untouched.
+    """
+
+    def __init__(self, searcher, policy: Optional[CompactionPolicy] = None,
+                 interval: float = 5.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 pre_publish: Optional[Callable[[], None]] = None):
+        self.searcher = searcher
+        self.policy = policy or CompactionPolicy()
+        self.interval = interval
+        self._sleep = sleep
+        self._pre_publish = pre_publish
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.passes = 0
+        self.skipped = 0
+        self.failures = 0
+
+    def should_run(self) -> bool:
+        """Tombstone fraction at or past the policy trigger."""
+        from raft_tpu.lifecycle.delete import tombstone_frac
+
+        index = getattr(self.searcher, "_index", None)
+        if index is None or not getattr(index, "n_deleted", 0):
+            return False
+        return tombstone_frac(index) >= self.policy.trigger_frac
+
+    def run_once(self, force: bool = False) -> Optional[CompactionReport]:
+        """One trigger check + (maybe) one pass; returns the report or
+        None when below the trigger (``force`` skips the check)."""
+        if not force and not self.should_run():
+            self.skipped += 1
+            return None
+        report = self.searcher.compact(self.policy,
+                                       pre_publish=self._pre_publish)
+        if report is not None:
+            self.passes += 1
+        return report
+
+    def start(self) -> None:
+        """Spawn the background loop (daemon; idempotent)."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.run_once()
+                except Exception:
+                    # A failed pass (e.g. an injected pre_publish
+                    # fault) published nothing — the daemon must
+                    # survive to retry, not die silently while
+                    # tombstones accumulate.
+                    self.failures += 1
+                    logger.warning("compaction pass failed; daemon "
+                                   "continues", exc_info=True)
+                self._sleep(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="raft-tpu-compactor")
+        self._thread.start()
+
+    def stop(self, timeout: Optional[float] = 5.0) -> None:
+        """Signal and join the background loop (idempotent). If the
+        loop is mid-pass past ``timeout``, the handle is kept so a
+        later ``start()`` cannot spawn a second concurrent loop."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "compactor loop still mid-pass after %.1fs join "
+                    "timeout; keeping the handle (call stop() again)",
+                    -1.0 if timeout is None else timeout)
+                return
+            self._thread = None

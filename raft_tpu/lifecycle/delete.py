@@ -1,0 +1,229 @@
+"""Tombstone delete + upsert for the IVF index families.
+
+Ref: FreshDiskANN (arXiv:2105.09613) deletes via a tombstone set
+consolidated later; RAFT's own indexes are append-only
+(ivf_flat::extend, detail/ivf_flat_build.cuh:159).  Here a delete
+writes a per-slot boolean mask carried on the index
+(``Index.deleted``); every scan engine folds it into the same
+``invalid`` mask that already hides below-fill padding, so tombstoned
+rows score as :func:`raft_tpu.core.sentinels.worst_value` and the
+results are EXACT over the survivors immediately — identical to an
+index rebuilt without the deleted rows, before any compaction runs.
+
+Tracing contract (the ``live_mask`` shape): ``deleted=None`` keeps the
+pre-lifecycle mask-free program byte-identical; the first delete
+switches to the masked trace (one compile, or zero if
+:func:`enable_tombstones` pre-attached the mask before warmup); every
+later delete mutates mask VALUES only — same shapes, no recompile.
+Delete-id batches are padded to the next power of two with ``PAD_ID``
+(which matches no live slot), so the membership program compiles per
+pow2 batch width, not per count.
+
+Epoch contract: ``delete`` bumps ``index.epoch`` exactly when any slot
+was newly tombstoned; ``upsert`` applies its tombstones silently and
+lets its internal extend carry the SINGLE bump, so no reader observes a
+committed epoch whose contents are half-applied.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.sentinels import PAD_ID
+from raft_tpu.neighbors import ivf_flat as _flat
+from raft_tpu.neighbors import ivf_pq as _pq
+from raft_tpu.parallel.ivf import (
+    ShardedIvfFlat,
+    ShardedIvfPq,
+    sharded_ivf_flat_extend,
+    sharded_ivf_pq_extend,
+)
+from raft_tpu.util.pow2 import next_pow2
+
+_INDEX_KINDS = (_flat.Index, _pq.Index, ShardedIvfFlat, ShardedIvfPq)
+
+
+def _is_sharded(index) -> bool:
+    return isinstance(index, (ShardedIvfFlat, ShardedIvfPq))
+
+
+def _check_index(index, mesh) -> None:
+    expects(isinstance(index, _INDEX_KINDS),
+            "lifecycle ops support ivf_flat/ivf_pq indexes "
+            "(single-host or sharded), got %s", type(index).__name__)
+    if _is_sharded(index):
+        expects(mesh is not None,
+                "sharded indexes need mesh= (the mesh their tensors "
+                "are placed over)")
+
+
+@jax.jit
+def _tombstone(indices, list_sizes, deleted, del_ids):
+    """Membership-mark pass: slots whose id is in ``del_ids`` AND below
+    their list's fill line become tombstones.  Pure (copy-on-write —
+    arrays read off the index before the delete stay valid); shapes in
+    == shapes out, so repeat deletes reuse one compiled program.
+    Returns ``(new_mask, newly_deleted_count)``."""
+    sorted_ids = jnp.sort(del_ids)
+    pos = jnp.searchsorted(sorted_ids, indices)
+    pos = jnp.minimum(pos, sorted_ids.shape[0] - 1)
+    hit = jnp.take(sorted_ids, pos) == indices
+    slot = jnp.arange(indices.shape[-1], dtype=jnp.int32)
+    valid = slot < list_sizes[..., None]
+    newly = hit & valid & ~deleted
+    return deleted | newly, jnp.sum(newly)
+
+
+def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
+    """Delete-id batch as a device array: pow2-padded with ``PAD_ID``
+    (never matches a live slot — live ids are >= 0), replicated over the
+    mesh for sharded indexes (a DECLARED placement, so the sanitizer
+    lane's transfer guard stays quiet)."""
+    raw = np.asarray(ids).reshape(-1)
+    if raw.size == 0:
+        return None
+    expects(int(raw.min()) >= 0, "ids must be >= 0 (got %s)",
+            int(raw.min()))
+    width = next_pow2(int(raw.size))
+    dtype = np.dtype(index.indices.dtype)
+    padded = np.full((width,), PAD_ID, dtype)
+    padded[:raw.size] = raw.astype(dtype)
+    if _is_sharded(index):
+        return jax.device_put(jnp.asarray(padded),
+                              NamedSharding(mesh, P()))
+    return jnp.asarray(padded)
+
+
+def _blank_mask(index, mesh) -> jax.Array:
+    """All-live tombstone mask with the index's slot layout (sharded
+    masks place sharded like the list tensors)."""
+    shape = index.indices.shape
+    mask = jnp.zeros(shape, bool)
+    if _is_sharded(index):
+        return jax.device_put(mask, NamedSharding(mesh, P(index.axis)))
+    return mask
+
+
+def _drop_derived(index) -> None:
+    """Invalidate derived caches that bake the validity mask in (the
+    compressed-scan operands) or depend on occupancy measurements."""
+    if isinstance(index, _pq.Index):
+        index._scan_ops = None      # embeds the invalid operand
+        index.reset_search_cache()
+    elif isinstance(index, _flat.Index):
+        index.reset_search_cache()
+    elif isinstance(index, ShardedIvfPq):
+        index._scan_cache = None    # embeds the invalid operand
+
+
+def enable_tombstones(index, mesh=None) -> None:
+    """Attach an all-live tombstone mask ahead of time, so the masked
+    search trace is the ONLY trace: warm it once (serve warmup) and the
+    first real ``delete`` never recompiles the serving path.  An
+    all-False mask is score-identical to no mask.  No epoch bump —
+    contents are unchanged."""
+    _check_index(index, mesh)
+    if index.deleted is None:
+        # An all-False mask answers every query identically to no mask:
+        # nothing a cached result could go stale against.
+        index.deleted = _blank_mask(index, mesh)  # analyze: epoch-bump-ok (identity mask)
+
+
+def tombstone_frac(index) -> float:
+    """Fraction of stored slots that are tombstoned — the compaction
+    trigger statistic (:class:`~raft_tpu.lifecycle.compact.Compactor`)."""
+    size = int(jnp.sum(index.list_sizes))
+    return index.n_deleted / size if size else 0.0
+
+
+def delete(index, ids, mesh=None) -> int:
+    """Tombstone the rows whose stored id is in ``ids``; returns how many
+    slots were newly tombstoned.  Ids with no live slot are ignored
+    (idempotent re-delete).  Exact immediately: every engine neutralizes
+    tombstoned slots at scoring, so survivors rank exactly as in an
+    index rebuilt without the deleted rows.  Bumps ``index.epoch`` (and
+    thereby invalidates ``ResultCache`` entries) only when something was
+    actually deleted."""
+    _check_index(index, mesh)
+    del_ids = _prepare_ids(index, ids, mesh)
+    if del_ids is None:
+        return 0
+    mask = index.deleted if index.deleted is not None \
+        else _blank_mask(index, mesh)
+    new_mask, cnt = _tombstone(index.indices, index.list_sizes, mask,
+                               del_ids)
+    n = int(jax.device_get(cnt))
+    if n == 0:
+        # Nothing matched: no mask attach, no bump — a no-op must not
+        # wipe warm caches or switch the serving trace (pre-attach the
+        # mask deliberately with enable_tombstones instead).
+        return 0
+    index.deleted = new_mask
+    index.n_deleted += n
+    _drop_derived(index)
+    index.epoch += 1      # cached results must not outlive old contents
+    return n
+
+
+def upsert(index, new_vectors, new_indices, mesh=None, *,
+           donate: bool = True):
+    """Replace-or-insert rows by explicit id: tombstone any live slots
+    carrying these ids, then extend with the new rows — under ONE epoch
+    bump (the extend's), so a reader never observes a committed epoch
+    where only half the upsert applies.  Ids must be unique within the
+    batch (two rows under one id would both serve).  Returns the index.
+
+    ``donate=False`` selects the copy-on-write extend — required when
+    reader threads may hold dispatched searches against the current
+    storage (the serving facade passes it; see ivf_flat.extend).
+
+    Sharded indexes keep the extend contract: the row count must divide
+    the mesh axis (pad upstream)."""
+    _check_index(index, mesh)
+    ids = np.asarray(new_indices).reshape(-1)
+    X = np.asarray(new_vectors)
+    # EVERY input contract is validated BEFORE the tombstone write: an
+    # extend failure after the mask applied would leave a half-mutated
+    # index under an unchanged epoch — the state this function exists
+    # to make unobservable.
+    expects(X.ndim == 2 and X.shape[0] == ids.size,
+            "upsert needs (n, dim) vectors with one id per row, got "
+            "%s rows / %s ids", X.shape, ids.size)
+    expects(X.shape[1] == index.centers.shape[1],
+            "upsert dim %s != index dim %s", X.shape[1],
+            index.centers.shape[1])
+    expects(np.unique(ids).size == ids.size,
+            "upsert ids must be unique within the batch")
+    if _is_sharded(index):
+        n_dev = mesh.shape[index.axis]
+        expects(X.shape[0] % n_dev == 0,
+                "sharded upsert rows (%s) must divide the mesh axis "
+                "(%s) — pad the batch upstream", X.shape[0], n_dev)
+    if ids.size == 0:
+        return index
+    del_ids = _prepare_ids(index, ids, mesh)
+    mask = index.deleted if index.deleted is not None \
+        else _blank_mask(index, mesh)
+    new_mask, cnt = _tombstone(index.indices, index.list_sizes, mask,
+                               del_ids)
+    # The extend below carries the upsert's single epoch bump — bumping
+    # here too would invalidate caches twice and expose the tombstone-
+    # only half state as a committed epoch.
+    index.deleted = new_mask  # analyze: epoch-bump-ok (extend below is the one bump)
+    index.n_deleted += int(jax.device_get(cnt))
+    _drop_derived(index)
+    if isinstance(index, ShardedIvfFlat):
+        return sharded_ivf_flat_extend(mesh, index, new_vectors, ids,
+                                       donate=donate)
+    if isinstance(index, ShardedIvfPq):
+        return sharded_ivf_pq_extend(mesh, index, new_vectors, ids,
+                                     donate=donate)
+    if isinstance(index, _pq.Index):
+        return _pq.extend(index, new_vectors, ids, donate=donate)
+    return _flat.extend(index, new_vectors, ids, donate=donate)

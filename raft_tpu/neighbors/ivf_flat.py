@@ -126,11 +126,27 @@ class Index:
     list_sizes: jax.Array       # (n_lists,) int32
     adaptive_centers: bool = False
     conservative_memory_allocation: bool = False
-    # Monotonic content version, bumped by every extend — the serving
-    # layer's cache-invalidation key (serve/cache.py), same contract as
-    # the sharded indexes (parallel/ivf.py). Process-local: not
-    # serialized (a reload re-validates caches by construction).
+    # Monotonic content version, bumped by every mutation (extend /
+    # delete / upsert; compaction publishes a successor index at
+    # epoch + 1) — the serving layer's cache-invalidation key
+    # (serve/cache.py), same contract as the sharded indexes
+    # (parallel/ivf.py). Process-local: not serialized (a reload
+    # re-validates caches by construction).
     epoch: int = 0
+    # Tombstone mask (raft_tpu/lifecycle): slot j of list l is deleted
+    # iff ``deleted[l, j]``. None (the common case) traces the
+    # pre-lifecycle mask-free program; once set, the mask is a TRACED
+    # OPERAND of every scan engine — deleting more rows re-uses the
+    # compiled masked trace (the live_mask contract). Serialized only
+    # when any slot is tombstoned.
+    deleted: Optional[jax.Array] = None   # (n_lists, cap) bool
+    # Host-side count of tombstoned slots (drives compaction triggers).
+    n_deleted: int = 0
+    # Next auto-assigned id (max(existing id) + 1), maintained by every
+    # extend; None = derive lazily from the stored ids (loaded index).
+    # ``index.size`` is NOT a valid id source: it collides after an
+    # explicit-id extend and after delete shrinks the live count.
+    _next_id: Optional[int] = None
 
     def __post_init__(self):
         # Cross-tensor shape consistency at construction: a corrupted or
@@ -160,6 +176,11 @@ class Index:
     @property
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
+
+    @property
+    def live_size(self) -> int:
+        """Rows that answer queries: ``size`` minus tombstoned slots."""
+        return self.size - self.n_deleted
 
     def reset_search_cache(self) -> None:
         """Drop the memoized auto-engine bucket capacity (measured from
@@ -288,17 +309,19 @@ def _scatter_append_core(store, ids, list_sizes, new_rows, new_ids, labels):
     return store, ids, list_sizes + counts.astype(jnp.int32), counts
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1), static_argnums=(6,))
-def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
-                    adaptive: bool = False, centers=None):
+def _scatter_append_impl(store, ids, list_sizes, new_rows, new_ids, labels,
+                         adaptive: bool = False, centers=None):
     """O(n_new) append into capacity-padded lists.
 
     Ref: the per-list append of ivf_flat::extend
     (detail/ivf_flat_build.cuh:159) — new rows land at each list's current
-    fill offset. ``store``/``ids`` are donated so XLA aliases the output
-    onto the existing buffers — no full-index gather or copy appears
-    anywhere in the program. Shared by ivf_flat (payload = vectors) and
-    ivf_pq (payload = packed code rows).
+    fill offset. Under :data:`_scatter_append` ``store``/``ids`` are
+    donated so XLA aliases the output onto the existing buffers — no
+    full-index gather or copy appears anywhere in the program;
+    :data:`_scatter_append_cow` is the copy-on-write twin for mutations
+    racing live readers (a donated buffer a dispatched search still
+    holds raises "buffer has been deleted or donated"). Shared by
+    ivf_flat (payload = vectors) and ivf_pq (payload = packed code rows).
     """
     store, ids, new_sizes, counts = _scatter_append_core(
         store, ids, list_sizes, new_rows, new_ids, labels)
@@ -317,6 +340,13 @@ def _scatter_append(store, ids, list_sizes, new_rows, new_ids, labels,
     return store, ids, new_sizes, centers
 
 
+_scatter_append = functools.partial(
+    jax.jit, donate_argnums=(0, 1), static_argnums=(6,))(
+        _scatter_append_impl)
+_scatter_append_cow = functools.partial(
+    jax.jit, static_argnums=(6,))(_scatter_append_impl)
+
+
 def _grown_cap(list_sizes, counts, cap: int, conservative: bool):
     """Post-append capacity: unchanged when everything fits, else the
     next power of two (amortized doubling, ivf_flat_types.hpp:65-73) or
@@ -330,10 +360,11 @@ def _grown_cap(list_sizes, counts, cap: int, conservative: bool):
 
 def _append_in_place(store, ids, list_sizes, payload, new_ids, labels,
                      conservative: bool, adaptive: bool = False,
-                     centers=None):
-    """Grow-if-needed + donated scatter-append, shared by ivf_flat (payload
+                     centers=None, donate: bool = True):
+    """Grow-if-needed + scatter-append, shared by ivf_flat (payload
     = vectors) and ivf_pq (payload = packed code rows). Returns
-    ``(store, ids, sizes, centers)``."""
+    ``(store, ids, sizes, centers)``. ``donate=False`` selects the
+    copy-on-write scatter (see _scatter_append_impl)."""
     counts = jnp.bincount(labels.astype(jnp.int32), length=store.shape[0])
     cap = store.shape[1]
     new_cap = _grown_cap(list_sizes, counts, cap, conservative)
@@ -341,13 +372,52 @@ def _append_in_place(store, ids, list_sizes, payload, new_ids, labels,
         # Amortized growth: pad in place — existing rows keep their slots.
         store = jnp.pad(store, ((0, 0), (0, new_cap - cap), (0, 0)))
         ids = jnp.pad(ids, ((0, 0), (0, new_cap - cap)), constant_values=-1)
-    return _scatter_append(store, ids, list_sizes,
-                           payload.astype(store.dtype), new_ids, labels,
-                           adaptive, centers)
+    scatter = _scatter_append if donate else _scatter_append_cow
+    return scatter(store, ids, list_sizes,
+                   payload.astype(store.dtype), new_ids, labels,
+                   adaptive, centers)
+
+
+def _auto_id_base(index) -> int:
+    """First free auto-assigned id: ``max(existing id) + 1``, tracked on
+    the index (``_next_id``) and derived from the stored ids when the
+    tracker is unset (a loaded index). ``index.size`` is NOT a valid
+    base — it collides with user-supplied ids after an explicit-id
+    extend, and with live ids once delete shrinks the live count.
+    Shared by the single-host and sharded extends."""
+    nid = getattr(index, "_next_id", None)
+    if nid is not None:
+        return nid
+    # Padding/invalid slots carry -1, real ids are >= 0, so the global
+    # max is the largest live-or-tombstoned id; empty index -> -1 -> 0.
+    return int(jnp.max(index.indices)) + 1
+
+
+def _track_next_id(index, new_indices, default_base=None,
+                   n_new: int = 0) -> None:
+    """Advance the auto-id tracker after an extend: default-numbered
+    appends advance it arithmetically (no device read); explicit ids
+    advance it past their max (one scalar readback, like the capacity
+    check)."""
+    cur = _auto_id_base(index)
+    if default_base is not None:
+        index._next_id = max(cur, default_base + n_new)
+    else:
+        index._next_id = max(cur, int(jnp.max(new_indices)) + 1)
+
+
+def _pad_deleted(deleted, new_cap: int):
+    """Grow the tombstone mask alongside a capacity-grown list tensor:
+    fresh slots are live by construction."""
+    if deleted is None or deleted.shape[-1] == new_cap:
+        return deleted
+    pad = ((0, 0),) * (deleted.ndim - 1) + ((0, new_cap - deleted.shape[-1]),)
+    return jnp.pad(deleted, pad)
 
 
 @traced
-def extend(index: Index, new_vectors, new_indices=None) -> Index:
+def extend(index: Index, new_vectors, new_indices=None, *,
+           donate: bool = True) -> Index:
     """Append vectors to the index, in place, at O(n_new) amortized cost.
 
     Ref: ivf_flat::extend (detail/ivf_flat_build.cuh:159; list growth
@@ -357,17 +427,26 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     capacity does storage grow — by padding to the doubled capacity,
     which moves no existing row. The passed ``index`` is mutated and
     returned; arrays previously read off it (``index.data`` etc.) must
-    be re-read after the call. When ``adaptive_centers`` is set, centers
-    drift to the running mean of their members (ivf_flat_types.hpp:53-58).
+    be re-read after the call. ``donate=False`` keeps the old storage
+    buffers valid (full copy-on-write scatter) — required when reader
+    threads may hold a dispatched search against them (the serving
+    facade passes it; docs/index_lifecycle.md). When
+    ``adaptive_centers`` is set, centers drift to the running mean of
+    their members (ivf_flat_types.hpp:53-58).
+
+    Tombstoned slots are NOT reclaimed here — extend appends at each
+    list's fill offset; reclamation is the compactor's job
+    (raft_tpu/lifecycle/compact.py).
     """
     X = as_array(new_vectors)
     expects(X.ndim == 2 and X.shape[1] == index.dim, "dim mismatch")
     n_new = X.shape[0]
     if n_new == 0:
         return index
+    default_base = None
     if new_indices is None:
-        base = index.size
-        new_indices = jnp.arange(base, base + n_new,
+        default_base = _auto_id_base(index)
+        new_indices = jnp.arange(default_base, default_base + n_new,
                                  dtype=index.indices.dtype)
     else:
         new_indices = as_array(new_indices).astype(index.indices.dtype)
@@ -394,6 +473,14 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                 sums / cnt[:, None], centers)
         index.data, index.indices, index.list_sizes = data, ids, sizes
         index.centers = centers
+        # Fresh fill: no tombstones — but an enable_tombstones
+        # pre-attachment survives (as an all-live mask at the new
+        # capacity), or the masked-trace warmup guarantee would
+        # silently void on the first bulk extend.
+        index.deleted = (None if index.deleted is None
+                         else jnp.zeros(ids.shape, bool))
+        index.n_deleted = 0
+        _track_next_id(index, new_indices, default_base, n_new)
         index.epoch += 1      # serving caches must not outlive old contents
         index.reset_search_cache()
         return index
@@ -402,10 +489,12 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
         index.data, index.indices, index.list_sizes, X, new_indices,
         labels, index.conservative_memory_allocation,
         index.adaptive_centers,
-        index.centers if index.adaptive_centers else None)
+        index.centers if index.adaptive_centers else None, donate=donate)
     index.data, index.indices, index.list_sizes = data, ids, sizes
+    index.deleted = _pad_deleted(index.deleted, data.shape[1])
     if index.adaptive_centers:
         index.centers = centers
+    _track_next_id(index, new_indices, default_base, n_new)
     index.epoch += 1          # serving caches must not outlive old contents
     index.reset_search_cache()  # occupancy changed
     return index
@@ -414,18 +503,25 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
 @functools.partial(jax.jit, static_argnums=(5, 6, 7))
 def _probe_scan(
     queries, data, data_sq_norms, indices, list_sizes, k: int, inner_is_l2: bool,
-    sqrt: bool, probe_ids=None,
+    sqrt: bool, probe_ids=None, deleted=None,
 ):
     """Scan probed lists, fold a running top-k.
 
     Ref: interleaved_scan_kernel (detail/ivf_flat_search.cuh:669) + the
     select_k merge (:944). One scan step handles probe-rank j for every
     query at once: gather list j's block, score on the MXU, merge.
+
+    ``deleted`` is the optional per-slot tombstone mask
+    (raft_tpu/lifecycle): tombstoned slots neutralize to the shared
+    worst-value sentinel exactly like below-fill padding — a traced
+    operand, so deleting more rows never retraces.
     """
+    from raft_tpu.core.sentinels import worst_value
+
     q, d = queries.shape
     cap = data.shape[1]
     qn = jnp.sum(queries * queries, axis=1) if inner_is_l2 else None
-    worst = jnp.inf if inner_is_l2 else -jnp.inf
+    worst = worst_value(inner_is_l2)
     slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
 
     def body(carry, probe_col):
@@ -434,6 +530,8 @@ def _probe_scan(
         block = data[lists]                     # (q, cap, d)
         ids = indices[lists]                    # (q, cap)
         invalid = slot >= list_sizes[lists][:, None]
+        if deleted is not None:
+            invalid |= deleted[lists]
         g = jnp.einsum("qd,qcd->qc", queries, block,
                        precision=lax.Precision.HIGHEST)
         if inner_is_l2:
@@ -624,7 +722,7 @@ def _pick_engine(engine: str, n_queries: int, n_probes: int, n_lists: int,
 def _bucketed_probe_scan(
     queries, data, indices, list_sizes, probe_ids,
     k: int, inner_is_l2: bool, sqrt: bool, bucket_cap: int,
-    interpret: bool = False, qsplit: bool = False,
+    interpret: bool = False, qsplit: bool = False, deleted=None,
 ):
     """Probe scan with the probe map inverted to per-list query buckets.
 
@@ -651,6 +749,8 @@ def _bucketed_probe_scan(
     qsel = jnp.maximum(bucket, 0)
     Qb = queries[qsel]                                         # (L, cap_q, d)
     invalid = jnp.arange(cap, dtype=jnp.int32)[None, :] >= list_sizes[:, None]
+    if deleted is not None:
+        invalid |= deleted           # tombstones mask exactly like padding
     bd_, bi_ = fused_batch_knn(
         Qb, data, invalid, k,
         metric="l2" if inner_is_l2 else "ip",
@@ -802,7 +902,7 @@ def _cells_eligible(engine: str, k: int, bucket_cap: int, cap: int,
 @functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
                   k: int, inner_is_l2: bool, sqrt: bool, qrows: int,
-                  qsplit: bool, interpret: bool = False):
+                  qsplit: bool, interpret: bool = False, deleted=None):
     """IVF-Flat search over packed query cells as ONE jitted program —
     coarse probe, cells inversion, fused Pallas scan, routing and the
     final merge (the round-4 engine treatment applied to IVF-Flat: no
@@ -817,6 +917,8 @@ def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
     Qc = Q[jnp.maximum(bucket, 0)]                 # (max_cells, qrows, d)
     invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
                >= list_sizes[:, None])
+    if deleted is not None:
+        invalid |= deleted           # tombstones mask exactly like padding
     bd_, bi_ = fused_cells_knn(cell_list, Qc, data, invalid, k,
                                l2=inner_is_l2,
                                bf16=data.dtype == jnp.bfloat16,
@@ -884,7 +986,7 @@ def search(
             Q, index.centers, dataf, index.indices, index.list_sizes,
             n_probes, k, inner_is_l2, sqrt,
             min(_CELL_QROWS, max(8, Q.shape[0])), qsplit,
-            jax.default_backend() != "tpu")
+            jax.default_backend() != "tpu", deleted=index.deleted)
 
     # Coarse quantizer: distances to centers + top-n_probes
     # (ref: select_clusters-analog in ivf_flat_search; the cells path
@@ -899,7 +1001,8 @@ def search(
         return _bucketed_probe_scan(
             Q, dataf, index.indices, index.list_sizes, probe_ids,
             k, inner_is_l2, sqrt, cap_q,
-            jax.default_backend() != "tpu", qsplit)
+            jax.default_backend() != "tpu", qsplit,
+            deleted=index.deleted)
 
     if inner_is_l2:
         # f32-accumulated norms without materializing a full f32 copy of
@@ -915,7 +1018,7 @@ def search(
     return _chunked_over_queries(
         lambda q_, p_: _probe_scan(q_, dataf, norms, index.indices,
                                    index.list_sizes, k, inner_is_l2, sqrt,
-                                   probe_ids=p_),
+                                   probe_ids=p_, deleted=index.deleted),
         Q, probe_ids, dataf.shape[1] * index.dim * 4)
 
 
@@ -948,6 +1051,11 @@ def save(filename: str, index: Index, retry=None) -> None:
         indices=np.asarray(index.indices),
         list_sizes=np.asarray(index.list_sizes),
     )
+    if index.n_deleted:
+        # Tombstones are index CONTENT (resurrecting deleted rows on a
+        # reload would be corruption); the key is written only when any
+        # slot is tombstoned, so mask-free files keep the v3 layout.
+        payload["deleted"] = np.asarray(index.deleted)
     with_retry(lambda: np.savez(filename, **payload),
                retry or DEFAULT_IO_RETRY)
 
@@ -973,6 +1081,7 @@ def load(filename: str, retry=None) -> Index:
     # idx_dtype knob: int64 ids without x64 enabled would otherwise be
     # silently truncated to int32 by jnp.asarray.
     validate_idx_dtype(z["indices"].dtype)
+    deleted = z.get("deleted")
     return Index(
         metric=DistanceType(int(z["metric"])),
         centers=jnp.asarray(z["centers"]),
@@ -981,4 +1090,6 @@ def load(filename: str, retry=None) -> Index:
         list_sizes=jnp.asarray(z["list_sizes"]),
         adaptive_centers=bool(z["adaptive_centers"]),
         conservative_memory_allocation=bool(z["conservative"]),
+        deleted=None if deleted is None else jnp.asarray(deleted),
+        n_deleted=0 if deleted is None else int(deleted.sum()),
     )

@@ -54,14 +54,17 @@ from raft_tpu.neighbors.ivf_flat import (
     _CELLS_MAX_K,      # a drifted local copy would mismatch the kernels
     _append_in_place,
     _auto_cap_cache,
+    _auto_id_base,
     _bucketed_probe_scan,
     _chunked_over_queries,
     _invert_probe_map,
     _invert_probe_map_cells,
     _pack_lists,
+    _pad_deleted,
     _pick_engine,
     _route_candidates,
     _route_candidates_cells,
+    _track_next_id,
 )
 from raft_tpu.random.rng_state import RngState
 from raft_tpu.util.pow2 import ceildiv, next_pow2
@@ -238,6 +241,16 @@ class Index:
     # caller's array is simply kept alive. Not serialized (load() leaves
     # it None; attach via refine-capable search_refined instead).
     _source: Optional[jax.Array] = None
+    # Tombstone mask (raft_tpu/lifecycle): slot j of list l is deleted
+    # iff ``deleted[l, j]`` — a traced operand of every scan tier (the
+    # compressed tier folds it into the cached ``invalid`` operand), so
+    # deleting more rows never retraces. Serialized only when any slot
+    # is tombstoned.
+    deleted: Optional[jax.Array] = None   # (n_lists, cap) bool
+    # Host-side count of tombstoned slots (drives compaction triggers).
+    n_deleted: int = 0
+    # Next auto-assigned id — see ivf_flat.Index._next_id.
+    _next_id: Optional[int] = None
 
     def __post_init__(self):
         # pq_dim is load-bearing (codes are bit-packed, so it is no longer
@@ -283,6 +296,11 @@ class Index:
     def size(self) -> int:
         return int(jnp.sum(self.list_sizes))
 
+    @property
+    def live_size(self) -> int:
+        """Rows that answer queries: ``size`` minus tombstoned slots."""
+        return self.size - self.n_deleted
+
     def reset_search_cache(self) -> None:
         """Drop the memoized query-distribution measurements: the
         auto-engine bucket capacity and the refine recipe's probe
@@ -313,6 +331,12 @@ class Index:
                 codesT = jnp.pad(codesT, ((0, 0), (0, 0), (0, capp - cap)))
             invalid = (jnp.arange(capp, dtype=jnp.int32)[None, :]
                        >= self.list_sizes[:, None])
+            if self.deleted is not None:
+                # Tombstones ride the existing invalid operand — same
+                # shape, so a delete never changes the compiled program
+                # (delete() drops _scan_ops; the rebuild lands here).
+                invalid |= jnp.pad(self.deleted,
+                                   ((0, 0), (0, capp - cap)))
             centers_rot = jnp.matmul(self.centers, self.rotation_matrix.T,
                                      precision=lax.Precision.HIGHEST)
             crot_p = permute_subspaces(centers_rot, self.pq_dim,
@@ -414,7 +438,7 @@ def _decode_lists_block(codes_c, crot_c, books_flat, J: int, B: int,
 def _bucketed_decode_scan(
     rotq, pq_codes, pq_centers, centers_rot, indices, list_sizes,
     probe_ids, k: int, is_ip: bool, per_cluster: bool, bucket_cap: int,
-    pq_dim: int, pq_bits: int, interpret: bool = False,
+    pq_dim: int, pq_bits: int, interpret: bool = False, deleted=None,
 ):
     """Bucketed PQ search that decodes codes to bf16 tiles on the fly —
     no persistent reconstruction cache, so PQ keeps its compression while
@@ -443,6 +467,8 @@ def _bucketed_decode_scan(
     Qb = rotq[qsel]                                   # (n_lists, cap_q, d)
     invalid = (jnp.arange(cap, dtype=jnp.int32)[None, :]
                >= list_sizes[:, None])
+    if deleted is not None:
+        invalid |= deleted           # tombstones mask exactly like padding
 
     # Block size: bound the decoded bf16 tile (+ the unpack intermediate)
     # to ~32 MB and keep it a divisor of n_lists for a clean scan.
@@ -1011,7 +1037,8 @@ def encode_rows(model, X) -> Tuple[jax.Array, jax.Array]:
 
 
 @traced
-def extend(index: Index, new_vectors, new_indices=None) -> Index:
+def extend(index: Index, new_vectors, new_indices=None, *,
+           donate: bool = True) -> Index:
     """Encode + append rows in place at O(n_new) amortized cost.
 
     Ref: ivf_pq::extend (ivf_pq_build.cuh:873 →
@@ -1021,26 +1048,32 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
     existing codes are never gathered or copied. Storage grows by padding
     to the doubled capacity on overflow. The passed ``index`` is mutated
     and returned; arrays previously read off it must be re-read after the
-    call."""
+    call. ``donate=False`` selects the copy-on-write scatter for
+    mutations racing live reader threads (see ivf_flat.extend)."""
     X = _as_float(new_vectors)
     expects(X.ndim == 2 and X.shape[1] == index.dim, "dim mismatch")
     n_new = X.shape[0]
     if n_new == 0:
         return index
     default_ids = new_indices is None
+    default_base = None
     if default_ids:
-        base = index.size
-        new_indices = jnp.arange(base, base + n_new,
+        # Auto ids allocate from max(existing id) + 1 (tracked on the
+        # index) — ``index.size`` would collide after an explicit-id
+        # extend and after delete shrinks the live count.
+        default_base = _auto_id_base(index)
+        new_indices = jnp.arange(default_base, default_base + n_new,
                                  dtype=index.indices.dtype)
     else:
         new_indices = as_array(new_indices).astype(index.indices.dtype)
 
     # Maintain the retained-dataset reference (min_recall refine): only
     # a default-numbered append onto a same-dtype source keeps the
-    # id -> source-row mapping valid; anything else drops it.
+    # id -> source-row mapping valid (ids [base, base+n) must name
+    # source rows [len(source), len(source)+n)); anything else drops it.
     if index._source is not None:
         raw = as_array(new_vectors)
-        if (default_ids and index._source.shape[0] == index.size
+        if (default_ids and index._source.shape[0] == default_base
                 and raw.dtype == index._source.dtype):
             index._source = jnp.concatenate([index._source, raw])
         else:
@@ -1058,14 +1091,24 @@ def extend(index: Index, new_vectors, new_indices=None) -> Index:
                                          index.n_lists, min_cap)
         index.pq_codes = packed.astype(jnp.uint8)
         index.indices, index.list_sizes = ids, sizes
+        # Fresh fill: no tombstones — but an enable_tombstones
+        # pre-attachment survives at the new capacity (see
+        # ivf_flat.extend's bulk path).
+        index.deleted = (None if index.deleted is None
+                         else jnp.zeros(ids.shape, bool))
+        index.n_deleted = 0
+        _track_next_id(index, new_indices, default_base, n_new)
         index.epoch += 1  # serving caches must not outlive old contents
         _invalidate_caches(index)
         return index
 
     store, ids, sizes, _ = _append_in_place(
         index.pq_codes, index.indices, index.list_sizes, codes,
-        new_indices, labels, index.conservative_memory_allocation)
+        new_indices, labels, index.conservative_memory_allocation,
+        donate=donate)
     index.pq_codes, index.indices, index.list_sizes = store, ids, sizes
+    index.deleted = _pad_deleted(index.deleted, store.shape[1])
+    _track_next_id(index, new_indices, default_base, n_new)
     index.epoch += 1      # serving caches must not outlive old contents
     _invalidate_caches(index)
     return index
@@ -1130,7 +1173,7 @@ def _pq_probe_scan(
     rotq, probe_ids, pq_codes, indices, list_sizes,
     k: int, is_ip: bool, per_cluster: bool, lut_dtype,
     pq_dim: int, pq_bits: int, internal_dtype=jnp.float32,
-    pq_centers=None, centers_rot=None,
+    pq_centers=None, centers_rot=None, deleted=None,
 ):
     """LUT-scored probe scan (ref: compute_similarity_kernel,
     ivf_pq_search.cuh:611 + select_k merge :1413).
@@ -1150,7 +1193,8 @@ def _pq_probe_scan(
     internal_dtype = jnp.dtype(internal_dtype)
     # ±inf exists in bf16/fp16; the carried best-k and per-step scores live
     # in internal_dtype (the reference's score_t, ivf_pq_types.hpp:122-131).
-    worst = jnp.array(-jnp.inf if is_ip else jnp.inf, internal_dtype)
+    from raft_tpu.core.sentinels import worst_value
+    worst = worst_value(not is_ip, internal_dtype)
     slot = jnp.arange(cap, dtype=jnp.int32)[None, :]
     rq3 = rotq.reshape(q, pq_dim, pq_len)
 
@@ -1185,6 +1229,8 @@ def _pq_probe_scan(
         codes = unpack_codes(pq_codes[lists], pq_dim, pq_bits)  # (q, cap, J)
         ids = indices[lists]
         invalid = slot >= list_sizes[lists][:, None]
+        if deleted is not None:
+            invalid |= deleted[lists]   # tombstones mask like padding
         # score[c] = Σ_j LUT[j, codes[c, j]] — one-hot matmuls on the MXU
         # (see _lut_scores: ~9× over take_along_axis gathers on TPU).
         if jnp.dtype(lut_dtype) == jnp.uint8:
@@ -1316,7 +1362,8 @@ def search(
             best_d, best_i = _bucketed_probe_scan(
                 rotq, index.reconstructed(),
                 index.indices, index.list_sizes, probe_ids,
-                k, not is_ip, False, cap_q, interpret)
+                k, not is_ip, False, cap_q, interpret,
+                deleted=index.deleted)
         else:
             # Large index: decode blocks on the fly — PQ keeps its
             # compression, no _RECON_AUTO_BYTES memory cliff.
@@ -1327,7 +1374,8 @@ def search(
                 index.indices, index.list_sizes, probe_ids,
                 k, is_ip,
                 index.codebook_kind == CodebookGen.PER_CLUSTER,
-                cap_q, index.pq_dim, index.pq_bits, interpret)
+                cap_q, index.pq_dim, index.pq_bits, interpret,
+                deleted=index.deleted)
         if index.metric == DistanceType.L2SqrtExpanded:
             best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
         return best_d, best_i
@@ -1349,6 +1397,7 @@ def search(
             lut_dtype, index.pq_dim, index.pq_bits,
             internal_dtype,
             pq_centers=index.pq_centers, centers_rot=centers_rot,
+            deleted=index.deleted,
         ),
         rotq, probe_ids, per_q)
     if index.metric == DistanceType.L2SqrtExpanded:
@@ -1488,6 +1537,10 @@ def save(filename: str, index: Index, retry=None) -> None:
         indices=np.asarray(index.indices),
         list_sizes=np.asarray(index.list_sizes),
     )
+    if index.n_deleted:
+        # Tombstones are index content — dropping them on a save/load
+        # round trip would resurrect deleted rows (see ivf_flat.save).
+        payload["deleted"] = np.asarray(index.deleted)
     with_retry(lambda: np.savez(filename, **payload),
                retry or DEFAULT_IO_RETRY)
 
@@ -1514,6 +1567,7 @@ def load(filename: str, retry=None) -> Index:
                if version == 3 else ""))
     # int64 ids require x64 — otherwise jnp.asarray silently truncates.
     validate_idx_dtype(z["indices"].dtype)
+    deleted = z.get("deleted")
     return Index(
         metric=DistanceType(int(z["metric"])),
         codebook_kind=CodebookGen(int(z["codebook_kind"])),
@@ -1526,4 +1580,6 @@ def load(filename: str, retry=None) -> Index:
         pq_bits=int(z["pq_bits"]),
         pq_dim=int(z["pq_dim"]),
         conservative_memory_allocation=bool(z["conservative"]),
+        deleted=None if deleted is None else jnp.asarray(deleted),
+        n_deleted=0 if deleted is None else int(deleted.sum()),
     )

@@ -70,10 +70,28 @@ class ShardedIvfFlat:
     indices: jax.Array      # (n_dev, n_lists, cap) global ids
     list_sizes: jax.Array   # (n_dev, n_lists) int32
     axis: str = "data"
-    # Monotonic content version, bumped by every extend — the serving
-    # layer's cache-invalidation key (serve/cache.py). Process-local:
-    # not serialized (a reload re-validates caches by construction).
+    # Monotonic content version, bumped by every mutation (extend /
+    # delete / upsert; compaction publishes a successor at epoch + 1) —
+    # the serving layer's cache-invalidation key (serve/cache.py).
+    # Process-local: not serialized (a reload re-validates caches by
+    # construction).
     epoch: int = 0
+    # Tombstone mask sharded like the list tensors (raft_tpu/lifecycle);
+    # None traces the mask-free program, set masks are traced operands
+    # (deleting more rows never retraces). See ivf_flat.Index.deleted.
+    deleted: Optional[jax.Array] = None   # (n_dev, n_lists, cap) bool
+    n_deleted: int = 0
+    # Next auto-assigned id — see ivf_flat.Index._next_id.
+    _next_id: Optional[int] = None
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    @property
+    def live_size(self) -> int:
+        """Rows that answer queries: ``size`` minus tombstoned slots."""
+        return self.size - self.n_deleted
 
 
 @dataclass
@@ -92,18 +110,35 @@ class ShardedIvfPq:
     pq_bits: int = 8
     pq_dim: int = 0
     axis: str = "data"
-    # Monotonic content version, bumped by every extend — the serving
-    # layer's cache-invalidation key (serve/cache.py). Process-local:
-    # not serialized (a reload re-validates caches by construction).
+    # Monotonic content version, bumped by every mutation (extend /
+    # delete / upsert; compaction publishes a successor at epoch + 1) —
+    # the serving layer's cache-invalidation key (serve/cache.py).
+    # Process-local: not serialized (a reload re-validates caches by
+    # construction).
     epoch: int = 0
     # Lazy per-shard compressed-scan operands (transposed codes sharded
     # over the mesh axis + replicated absolute tables); rebuilt after
-    # extend/load. Not serialized. See _sharded_scan_operands.
+    # extend/delete/load. Not serialized. See _sharded_scan_operands.
     _scan_cache: Optional[tuple] = None
+    # Tombstone mask sharded like the code tensors (raft_tpu/lifecycle);
+    # the compressed tier folds it into the cached invalid operand.
+    deleted: Optional[jax.Array] = None   # (n_dev, n_lists, cap) bool
+    n_deleted: int = 0
+    # Next auto-assigned id — see ivf_flat.Index._next_id.
+    _next_id: Optional[int] = None
 
     @property
     def rot_dim(self) -> int:
         return self.rotation_matrix.shape[0]
+
+    @property
+    def size(self) -> int:
+        return int(jnp.sum(self.list_sizes))
+
+    @property
+    def live_size(self) -> int:
+        """Rows that answer queries: ``size`` minus tombstoned slots."""
+        return self.size - self.n_deleted
 
 
 def _shard_pack(mesh: Mesh, axis: str, rows, labels_h, ids, n_lists: int):
@@ -172,17 +207,25 @@ def sharded_ivf_flat_build(
     jax.jit, static_argnames=("mesh", "axis", "k", "n_probes",
                               "inner_is_l2", "sqrt", "use_cells", "qrows",
                               "interpret", "engine"))
-def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None, *,
+def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None,
+                             tomb=None, *,
                              mesh, axis, k, n_probes, inner_is_l2, sqrt,
                              use_cells, qrows, interpret, engine):
     # jit around shard_map is load-bearing: un-jitted shard_map runs in the
     # eager SPMD interpreter (~10x slower, measured on the CPU mesh).
     # ``live=None`` traces the pre-fault-tolerance two-output program —
-    # the all-live path stays bit-identical and pays nothing.
+    # the all-live path stays bit-identical and pays nothing.  ``tomb``
+    # (the sharded tombstone mask, raft_tpu/lifecycle) follows the same
+    # contract: None keeps the mask-free trace; a set mask is a traced
+    # per-shard operand, so further deletes never retrace.
     has_live = live is not None
+    has_tomb = tomb is not None
 
     def body(data_l, idx_l, sz_l, centers_r, q, *rest):
         data_l, idx_l, sz_l = data_l[0], idx_l[0], sz_l[0]
+        rest = list(rest)
+        alive_mask = rest.pop(0) if has_live else None
+        tomb_l = rest.pop(0)[0] if has_tomb else None
         # Per-device top-k is bounded by this shard's slot capacity.
         kk = min(k, data_l.shape[0] * data_l.shape[1])
         if use_cells:
@@ -193,16 +236,18 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None, *,
             # sqrt is deferred to after the collective merge.
             d, i = _flat._cells_search(
                 q, centers_r, data_l, idx_l, sz_l, n_probes, kk,
-                inner_is_l2, False, qrows, False, interpret)
+                inner_is_l2, False, qrows, False, interpret,
+                deleted=tomb_l)
         else:
             probe_ids = _flat._coarse_probe(q, centers_r, n_probes,
                                             inner_is_l2)
             norms = (jnp.sum(data_l * data_l, axis=2)
                      if inner_is_l2 else None)
             d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
-                                     inner_is_l2, False, probe_ids=probe_ids)
+                                     inner_is_l2, False,
+                                     probe_ids=probe_ids, deleted=tomb_l)
         if has_live:
-            alive = local_alive(rest[0], axis)
+            alive = local_alive(alive_mask, axis)
             d, i = neutralize_dead(d, i, alive, inner_is_l2)
         # Merge the per-shard top-k inside the collective (topk_merge).
         out_d, out_i = topk_merge(d, i, k, axis, select_min=inner_is_l2,
@@ -220,11 +265,14 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None, *,
         return out_d, out_i, cov
 
     extra_in, extra_out = live_specs(has_live)
+    if has_tomb:
+        extra_in = extra_in + (P(axis),)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(), P()) + extra_in,
         out_specs=(P(), P()) + extra_out)
-    return fn(data, indices, sizes, centers, Q, *live_args(live))
+    args = live_args(live) + ((tomb,) if has_tomb else ())
+    return fn(data, indices, sizes, centers, Q, *args)
 
 
 def sharded_ivf_flat_search(
@@ -274,7 +322,7 @@ def sharded_ivf_flat_search(
             else check_live_mask(live_mask, mesh.shape[index.axis], mesh))
     return _sharded_flat_search_jit(
         index.data, index.indices, index.list_sizes, index.centers, Q,
-        live, mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
+        live, index.deleted, mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
         inner_is_l2=inner_is_l2, sqrt=sqrt, use_cells=use_cells,
         qrows=min(_flat._CELL_QROWS, max(8, Q.shape[0])),
         interpret=jax.default_backend() != "tpu",
@@ -333,9 +381,15 @@ def _sharded_scan_operands(mesh: Mesh, index: ShardedIvfPq) -> tuple:
             codesT = jnp.pad(codesT,
                              ((0, 0), (0, 0), (0, 0), (0, capp - cap)))
         codesT = jax.device_put(codesT, sharding)
-        invalid = jax.device_put(
-            jnp.arange(capp, dtype=jnp.int32)[None, None, :]
-            >= index.list_sizes[:, :, None], sharding)
+        invalid = (jnp.arange(capp, dtype=jnp.int32)[None, None, :]
+                   >= index.list_sizes[:, :, None])
+        if index.deleted is not None:
+            # Tombstones ride the existing invalid operand (same shape,
+            # so a delete never changes the compiled program; delete()
+            # drops _scan_cache and the rebuild lands here).
+            invalid |= jnp.pad(index.deleted,
+                               ((0, 0), (0, 0), (0, capp - cap)))
+        invalid = jax.device_put(invalid, sharding)
         centers_rot = jnp.matmul(index.centers, index.rotation_matrix.T,
                                  precision=lax.Precision.HIGHEST)
         crot_p = replicated(
@@ -404,13 +458,18 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
                               "per_cluster", "pq_dim", "pq_bits", "sqrt",
                               "lut_dtype", "internal_dtype", "engine"))
 def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q,
-                           live=None, *, mesh, axis, k, n_probes, is_ip,
-                           per_cluster, pq_dim, pq_bits, sqrt, lut_dtype,
+                           live=None, tomb=None, *, mesh, axis, k,
+                           n_probes, is_ip, per_cluster, pq_dim, pq_bits,
+                           sqrt, lut_dtype,
                            internal_dtype=jnp.float32, engine="allgather"):
     has_live = live is not None
+    has_tomb = tomb is not None
 
     def body(codes_l, idx_l, sz_l, centers_r, rot_r, books_r, q, *rest):
         codes_l, idx_l, sz_l = codes_l[0], idx_l[0], sz_l[0]
+        rest = list(rest)
+        alive_mask = rest.pop(0) if has_live else None
+        tomb_l = rest.pop(0)[0] if has_tomb else None
         probe_ids = _pq._select_clusters((q, centers_r), n_probes, is_ip)
         rotq = jnp.matmul(q, rot_r.T, precision=lax.Precision.HIGHEST)
         centers_rot = jnp.matmul(centers_r, rot_r.T,
@@ -419,9 +478,9 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q,
         d, i = _pq._pq_probe_scan(
             rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
             lut_dtype, pq_dim, pq_bits, internal_dtype,
-            pq_centers=books_r, centers_rot=centers_rot)
+            pq_centers=books_r, centers_rot=centers_rot, deleted=tomb_l)
         if has_live:
-            alive = local_alive(rest[0], axis)
+            alive = local_alive(alive_mask, axis)
             d, i = neutralize_dead(d, i, alive, not is_ip)
         out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
                                   engine=engine)
@@ -433,13 +492,15 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q,
         return out_d, out_i, cov
 
     extra_in, extra_out = live_specs(has_live)
+    if has_tomb:
+        extra_in = extra_in + (P(axis),)
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(), P(), P(), P())
         + extra_in,
         out_specs=(P(), P()) + extra_out)
-    return fn(codes, indices, sizes, centers, rot, books, Q,
-              *live_args(live))
+    args = live_args(live) + ((tomb,) if has_tomb else ())
+    return fn(codes, indices, sizes, centers, rot, books, Q, *args)
 
 
 def sharded_ivf_pq_search(
@@ -502,7 +563,7 @@ def sharded_ivf_pq_search(
             interpret=jax.default_backend() != "tpu", engine=engine)
     return _sharded_pq_search_jit(
         index.pq_codes, index.indices, index.list_sizes, index.centers,
-        index.rotation_matrix, index.pq_centers, Q, live,
+        index.rotation_matrix, index.pq_centers, Q, live, index.deleted,
         mesh=mesh, axis=index.axis, k=k, n_probes=n_probes, is_ip=is_ip,
         per_cluster=index.codebook_kind == _pq.CodebookGen.PER_CLUSTER,
         pq_dim=index.pq_dim, pq_bits=index.pq_bits,
@@ -515,21 +576,30 @@ def sharded_ivf_pq_search(
 # grows per-rank state with the same versioned serializers as the
 # single-device index, detail/ivf_pq_serialize.cuh:38-100).
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
-def _sharded_scatter_append(store, ids, sizes, payload, new_ids, labels):
-    """vmapped O(n_new) append over the shard axis; ``store``/``ids`` are
-    donated so each shard's buffer is updated in place (see
-    ivf_flat._scatter_append_core)."""
+def _sharded_scatter_append_impl(store, ids, sizes, payload, new_ids,
+                                 labels):
+    """vmapped O(n_new) append over the shard axis; under the donating
+    jit each shard's buffer is updated in place (see
+    ivf_flat._scatter_append_core); the _cow twin preserves the inputs
+    for mutations racing live reader threads."""
     st, id_, sz, _ = jax.vmap(_flat._scatter_append_core)(
         store, ids, sizes, payload, new_ids, labels)
     return st, id_, sz
 
 
-def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
+_sharded_scatter_append = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_sharded_scatter_append_impl)
+_sharded_scatter_append_cow = jax.jit(_sharded_scatter_append_impl)
+
+
+def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels,
+                    donate: bool = True, default_base=None):
     """Shared grow+append for both sharded index kinds. ``payload`` is the
     per-row storage payload (vectors / packed code rows), already encoded;
     rows are dealt to shards contiguously (n_new % n_dev == 0, the build
-    contract)."""
+    contract). ``donate=False`` selects the copy-on-write scatter;
+    ``default_base`` is _resolve_new_ids' host-computed auto-id base, so
+    the id tracker advances without a device readback on that path."""
     axis = index.axis
     n_dev = mesh.shape[axis]
     store = getattr(index, store_name)
@@ -555,10 +625,17 @@ def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
         index.indices = jax.device_put(
             jnp.pad(index.indices, ((0, 0), (0, 0), (0, new_cap - cap)),
                     constant_values=PAD_ID), sharding)
-    st, id_, sz = _sharded_scatter_append(
+        if index.deleted is not None:
+            # Grow the tombstone mask alongside: fresh slots are live.
+            index.deleted = jax.device_put(
+                _flat._pad_deleted(index.deleted, new_cap), sharding)
+    scatter = (_sharded_scatter_append if donate
+               else _sharded_scatter_append_cow)
+    st, id_, sz = scatter(
         store, index.indices, index.list_sizes, pl, ni, lb)
     setattr(index, store_name, st)
     index.indices, index.list_sizes = id_, sz
+    _flat._track_next_id(index, new_ids, default_base, n_new)
     if hasattr(index, "_scan_cache"):
         index._scan_cache = None  # codes/occupancy changed
     index.epoch += 1              # invalidates serving-layer result caches
@@ -566,38 +643,52 @@ def _sharded_extend(mesh, index, store_name: str, payload, new_ids, labels):
 
 
 def _resolve_new_ids(index, n_new: int, new_indices):
-    """Default ids continue the global row numbering (same contract as the
-    single-device extend)."""
+    """Default ids allocate from ``max(existing id) + 1`` (tracked on the
+    index — same contract as the single-device extend; the old
+    ``sum(list_sizes)`` base collided with user-supplied ids after an
+    explicit-id extend, and with live ids once delete shrinks the live
+    count). Returns ``(ids, default_base)`` — base is None for
+    explicit ids (the tracker then advances off their device max)."""
     if new_indices is None:
-        base = int(jnp.sum(index.list_sizes))
-        return jnp.arange(base, base + n_new, dtype=index.indices.dtype)
-    return jnp.asarray(new_indices).astype(index.indices.dtype)
+        base = _flat._auto_id_base(index)
+        return (jnp.arange(base, base + n_new,
+                           dtype=index.indices.dtype), base)
+    return jnp.asarray(new_indices).astype(index.indices.dtype), None
 
 
 def sharded_ivf_flat_extend(mesh: Mesh, index: ShardedIvfFlat, new_vectors,
-                            new_indices=None) -> ShardedIvfFlat:
+                            new_indices=None, *,
+                            donate: bool = True) -> ShardedIvfFlat:
     """Append rows to the sharded index in place at O(n_new) per shard
     (ref: ivf_flat::extend + the MNMG shard recipe). New rows are dealt
     contiguously across shards and scatter into each shard's free list
-    slots; the shared coarse model is unchanged."""
+    slots; the shared coarse model is unchanged. ``donate=False``
+    preserves the old shard buffers (copy-on-write) for mutations
+    racing live reader threads (see ivf_flat.extend)."""
     X = _flat._as_float(_flat.as_array(new_vectors))
     expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
-    new_indices = _resolve_new_ids(index, X.shape[0], new_indices)
+    new_indices, default_base = _resolve_new_ids(index, X.shape[0],
+                                                 new_indices)
     labels = kmeans_balanced.predict(
         KMeansBalancedParams(metric=index.metric), index.centers, X)
-    return _sharded_extend(mesh, index, "data", X, new_indices, labels)
+    return _sharded_extend(mesh, index, "data", X, new_indices, labels,
+                           donate=donate, default_base=default_base)
 
 
 def sharded_ivf_pq_extend(mesh: Mesh, index: ShardedIvfPq, new_vectors,
-                          new_indices=None) -> ShardedIvfPq:
+                          new_indices=None, *,
+                          donate: bool = True) -> ShardedIvfPq:
     """Encode + append rows to the sharded PQ index in place (ref:
-    ivf_pq::extend against the replicated model)."""
+    ivf_pq::extend against the replicated model). ``donate=False``
+    selects the copy-on-write scatter (see ivf_flat.extend)."""
     X = _pq._as_float(_pq.as_array(new_vectors))
     expects(X.shape[1] == index.centers.shape[1], "dim mismatch")
-    new_indices = _resolve_new_ids(index, X.shape[0], new_indices)
+    new_indices, default_base = _resolve_new_ids(index, X.shape[0],
+                                                 new_indices)
     labels, codes = _pq.encode_rows(index, X)
     return _sharded_extend(mesh, index, "pq_codes", codes, new_indices,
-                           labels)
+                           labels, donate=donate,
+                           default_base=default_base)
 
 
 SHARDED_SERIALIZATION_VERSION = 1
@@ -656,9 +747,14 @@ def sharded_ivf_save(basename: str, index) -> None:
 
     stores, ids, sizes = (by_start(a) for a in
                           (store, index.indices, index.list_sizes))
+    # Tombstones are index content (see ivf_flat.save): written per
+    # shard only when any slot is tombstoned, keeping mask-free files
+    # byte-compatible with the v1 layout.
+    dels = by_start(index.deleted) if index.n_deleted else None
     for s, payload in stores.items():
+        extra = {} if dels is None else {"deleted": dels[s]}
         np.savez(f"{basename}.shard{s}.npz", store=payload,
-                 indices=ids[s], list_sizes=sizes[s])
+                 indices=ids[s], list_sizes=sizes[s], **extra)
 
 
 def sharded_ivf_load(mesh: Mesh, basename: str):
@@ -678,8 +774,10 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
         model = {k: m[k] for k in m.files}
     sharding = NamedSharding(mesh, P(axis))
     with np.load(f"{basename}.shard0.npz") as z0:
-        shapes = {k: (z0[k].shape, z0[k].dtype)
-                  for k in ("store", "indices", "list_sizes")}
+        keys = ["store", "indices", "list_sizes"]
+        if "deleted" in z0.files:
+            keys.append("deleted")
+        shapes = {k: (z0[k].shape, z0[k].dtype) for k in keys}
     # int64 ids require x64 — without the guard the device placement
     # silently truncates (same contract as ivf_flat.load / ivf_pq.load).
     validate_idx_dtype(shapes["indices"][1])
@@ -693,8 +791,7 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
     def shard_arrays(s: int):
         if s not in shard_cache:
             with np.load(f"{basename}.shard{s}.npz") as z:
-                shard_cache[s] = {k: z[k] for k in
-                                  ("store", "indices", "list_sizes")}
+                shard_cache[s] = {k: z[k] for k in keys}
         return shard_cache[s]
 
     def placed(key):
@@ -721,6 +818,14 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
     store = placed("store")
     ids = placed("indices")
     sizes = placed("list_sizes")
+    deleted, n_del = None, 0
+    if "deleted" in keys:
+        deleted = placed("deleted")
+        # Global tombstone count summed on host per shard file (every
+        # process can read the shared files; a jnp.sum over the placed
+        # global array would not be multi-process addressable).
+        for s in range(n_shards):
+            n_del += int(shard_arrays(s)["deleted"].sum())
     shard_cache.clear()
     centers = jnp.asarray(model["centers"])
     if kind == "pq":
@@ -732,7 +837,8 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
             pq_centers=jnp.asarray(model["pq_centers"]),
             pq_codes=store, indices=ids, list_sizes=sizes,
             pq_bits=int(model["pq_bits"]), pq_dim=int(model["pq_dim"]),
-            axis=axis)
+            axis=axis, deleted=deleted, n_deleted=n_del)
     return ShardedIvfFlat(
         metric=DistanceType(int(model["metric"])), centers=centers,
-        data=store, indices=ids, list_sizes=sizes, axis=axis)
+        data=store, indices=ids, list_sizes=sizes, axis=axis,
+        deleted=deleted, n_deleted=n_del)

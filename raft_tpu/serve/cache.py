@@ -9,12 +9,18 @@ answers those without touching the mesh.
 
 Correctness contract: the key is ``(index epoch, query bytes, k)``.
 The epoch — threaded from ``ShardedIvfFlat.epoch`` /
-``ShardedIvfPq.epoch`` (bumped by every ``extend``) through
-``Searcher.epoch`` — makes stale hits impossible: growing the index
-changes the key space, so entries written against the old index can
-never answer for the new one. ``invalidate()`` additionally drops the
-dead entries eagerly (they could otherwise occupy LRU capacity until
-evicted).
+``ShardedIvfPq.epoch`` (bumped by every mutation: ``extend``,
+``lifecycle.delete``, ``lifecycle.upsert``, and each compaction
+publish) through ``Searcher.epoch`` — makes stale hits impossible:
+mutating the index changes the key space, so entries written against
+the old contents can never answer for the new ones.  This is also what
+makes lifecycle racing safe: a search dispatched against the
+pre-mutation snapshot writes its answer under the OLD epoch
+(``BatchScheduler._dispatch`` captures the epoch before searching), so
+the entry is unreachable the moment the mutation commits — a deleted
+row can never be served from cache after its delete's epoch is
+current. ``invalidate()`` additionally drops the dead entries eagerly
+(they could otherwise occupy LRU capacity until evicted).
 """
 
 from __future__ import annotations

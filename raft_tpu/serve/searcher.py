@@ -21,12 +21,21 @@ fault-tolerance and collective layers already provide:
 * ``RetryPolicy`` — transient host-side failures retry with the
   deterministic backoff of ``core/retry.py``;
 * ``epoch`` — the cache-invalidation key (serve/cache.py): bumped by
-  every extend, so cached results can never outlive the index state
-  they were computed against.
+  every mutation (extend / delete / upsert / compact), so cached
+  results can never outlive the index state they were computed against.
+
+Write side (raft_tpu/lifecycle, docs/index_lifecycle.md): ``delete``
+tombstones rows (exact-over-survivors immediately), ``upsert``
+replaces rows under one epoch bump, ``compact`` publishes a
+copy-on-write successor index by swapping one reference — in-flight
+batches keep searching their dispatch-time snapshot.  Mutations
+serialize on an internal lock; searches never take it (they read one
+index reference, and every published state is internally consistent).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, List, Optional
@@ -89,6 +98,10 @@ class Searcher:
         self._params = search_params
         self._db = db
         self._base_epoch = 0
+        # Serializes mutations (extend/delete/upsert/compact) against
+        # each other — a compaction racing an extend would publish a
+        # successor missing the extend's rows.  Searches never take it.
+        self._lock = threading.Lock()
         self._invalidation_hooks: List[Callable[[], None]] = []
         if kind == "brute_force" and mesh is not None:
             from raft_tpu.parallel.knn import shard_database
@@ -136,19 +149,30 @@ class Searcher:
 
     def add_invalidation_hook(
             self, hook: Callable[[], None]) -> Callable[[], None]:
-        """Run ``hook()`` after every extend (the scheduler registers
+        """Run ``hook()`` after every mutation (the scheduler registers
         its ResultCache.invalidate here). Returns an idempotent
         unsubscribe callable — a Searcher outlives its schedulers, so
         an unremovable hook would retain every retired cache forever."""
-        self._invalidation_hooks.append(hook)
+        with self._lock:
+            self._invalidation_hooks.append(hook)
 
         def remove() -> None:
-            try:
-                self._invalidation_hooks.remove(hook)
-            except ValueError:
-                pass
+            with self._lock:
+                try:
+                    self._invalidation_hooks.remove(hook)
+                except ValueError:
+                    pass
 
         return remove
+
+    def _fire_hooks(self) -> None:
+        """Invoke the invalidation hooks OUTSIDE the mutation lock (a
+        hook may take its own lock; holding ours across foreign code
+        invites lock-order inversions)."""
+        with self._lock:
+            hooks = list(self._invalidation_hooks)
+        for hook in hooks:
+            hook()
 
     # -- serving -----------------------------------------------------------
     def _resolve_live(self, degraded: Optional[bool]):
@@ -238,6 +262,22 @@ class Searcher:
         Sharded endpoints keep the build-time contract: TOTAL rows after
         the extend must divide the mesh axis (pad the increment upstream
         — zero-row padding would otherwise surface as fake neighbors)."""
+        with self._lock:
+            self._extend_locked(new_vectors, new_indices)
+        self._fire_hooks()
+
+    def _mutable_snapshot(self):
+        """Shallow copy of the served index for a mutate-then-swap
+        publish: the module-level mutators write the COPY's fields, the
+        served object stays internally consistent for lock-free readers
+        (array values are immutable), and one reference assignment
+        commits the whole mutation — the same snapshot contract
+        compact() gets from its copy-on-write successor."""
+        import copy
+
+        return copy.copy(self._index)
+
+    def _extend_locked(self, new_vectors, new_indices=None) -> None:
         if self.kind == "brute_force":
             import jax.numpy as jnp
 
@@ -263,17 +303,99 @@ class Searcher:
 
             fn = (sharded_ivf_flat_extend if self.kind == "ivf_flat"
                   else sharded_ivf_pq_extend)
-            fn(self.mesh, self._index, new_vectors, new_indices)
+            # Mutate a snapshot, publish by one reference swap: a
+            # lock-free reader must never observe a half-assigned field
+            # set (e.g. capacity-grown data next to old-cap indices).
+            # donate=False: readers may hold dispatched searches
+            # against the current buffers — donation would invalidate
+            # them mid-flight.
+            tmp = self._mutable_snapshot()
+            fn(self.mesh, tmp, new_vectors, new_indices, donate=False)
+            self._index = tmp
         else:
             from raft_tpu.neighbors import ivf_flat, ivf_pq
 
             mod = ivf_flat if self.kind == "ivf_flat" else ivf_pq
             # extend bumps the Index's own .epoch (the counter this
             # facade's ``epoch`` property reads) — no _base_epoch bump,
-            # or every extend would count twice.
-            mod.extend(self._index, new_vectors, new_indices)
-        for hook in self._invalidation_hooks:
-            hook()
+            # or every extend would count twice. Snapshot-swap +
+            # donate=False: see the sharded branch.
+            tmp = self._mutable_snapshot()
+            mod.extend(tmp, new_vectors, new_indices, donate=False)
+            self._index = tmp
+
+    def delete(self, ids) -> int:
+        """Tombstone rows by stored id (raft_tpu/lifecycle): exact over
+        the survivors immediately, no recompile per delete (the mask is
+        a traced operand).  Returns how many slots were newly
+        tombstoned; bumps the epoch (invalidating cached results) only
+        when that count is non-zero.  IVF endpoints only — the
+        brute-force database has no id-stable delete story."""
+        expects(self.kind != "brute_force",
+                "delete needs an IVF index (brute-force rows are "
+                "positional; rebuild the endpoint instead)")
+        from raft_tpu.lifecycle import delete as _delete
+
+        with self._lock:
+            tmp = self._mutable_snapshot()
+            n = _delete(tmp, ids, mesh=self.mesh)
+            if n:
+                self._index = tmp     # snapshot-swap publish
+        if n:
+            self._fire_hooks()
+        return n
+
+    def upsert(self, new_vectors, new_indices) -> None:
+        """Replace-or-insert rows by explicit id under ONE epoch bump
+        (tombstone + extend; raft_tpu/lifecycle.upsert) — no reader
+        observes the half-applied state as a committed epoch."""
+        expects(self.kind != "brute_force",
+                "upsert needs an IVF index (brute-force rows are "
+                "positional; rebuild the endpoint instead)")
+        from raft_tpu.lifecycle import upsert as _upsert
+
+        with self._lock:
+            # Snapshot-swap publish + donate=False — see _extend_locked.
+            tmp = self._mutable_snapshot()
+            _upsert(tmp, new_vectors, new_indices, mesh=self.mesh,
+                    donate=False)
+            self._index = tmp
+        self._fire_hooks()
+
+    def compact(self, policy=None, pre_publish=None):
+        """Run one compaction pass (raft_tpu/lifecycle/compact.py) and
+        publish its copy-on-write successor index by swapping ONE
+        reference under the mutation lock — in-flight batches keep
+        searching their dispatch-time snapshot, whose cache entries die
+        with the old epoch.  Returns the
+        :class:`~raft_tpu.lifecycle.compact.CompactionReport`, or None
+        when there was nothing to do.  ``pre_publish`` runs after the
+        successor is built, before the swap (the chaos injection point:
+        a fault there publishes nothing)."""
+        expects(self.kind != "brute_force",
+                "compact applies to IVF indexes (brute-force holds no "
+                "tombstones)")
+        from raft_tpu.lifecycle import compact as _compact
+
+        with self._lock:
+            new, report = _compact(self._index, policy, mesh=self.mesh)
+            if report is None:
+                return None
+            if pre_publish is not None:
+                pre_publish()
+            self._index = new
+        self._fire_hooks()
+        return report
+
+    @property
+    def tombstone_frac(self) -> float:
+        """Fraction of stored slots tombstoned (the Compactor trigger
+        statistic); 0.0 for brute-force endpoints."""
+        if self.kind == "brute_force":
+            return 0.0
+        from raft_tpu.lifecycle import tombstone_frac as _frac
+
+        return _frac(self._index)
 
     def __repr__(self) -> str:
         return ("Searcher(kind=%r, sharded=%s, epoch=%s, engine=%r)"

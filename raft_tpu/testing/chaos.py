@@ -131,6 +131,14 @@ class ChaosMonkey:
 
         return chaotic
 
+    def hook(self, site: str) -> Callable[[], None]:
+        """A zero-arg callable that :meth:`fire`\\ s ``site`` — the shape
+        lifecycle hook points take (e.g. ``Compactor(pre_publish=
+        chaos.hook("compact.publish"))`` scripts a fault between a
+        compaction pass building its successor index and the publish
+        swap, proving the no-partial-publish contract)."""
+        return lambda: self.fire(site)
+
     def fire(self, site: str):
         """Bare call-site hook for code that has no convenient callable to
         wrap: bumps the site counter and raises/drops per the script.

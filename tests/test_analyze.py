@@ -371,6 +371,36 @@ def test_epoch_waiver_and_future_lifecycle_names():
                ["epoch-bump"]) == []
 
 
+@pytest.mark.parametrize("body,should_flag", [
+    # tombstone-mask write without a bump: the ISSUE-8 mutation surface
+    # (a mask write changes which rows answer queries like a row write)
+    ("    index.deleted = rows\n    return index", True),
+    ("    index.deleted = rows\n    index.epoch += 1\n    return index",
+     False),
+    # list_sizes rewrite (compaction-shaped) without a bump
+    ("    index.list_sizes = index.list_sizes - rows\n    return index",
+     True),
+    ("    index.list_sizes = index.list_sizes - rows\n"
+     "    index.epoch += 1\n    return index", False),
+    # mask write on one branch only: that path is flagged
+    ("    if rows is not None:\n        index.deleted = rows\n"
+     "    return index", True),
+])
+def test_epoch_bump_lifecycle_mutation_surfaces(body, should_flag):
+    """The widened STORAGE_ATTRS set: tombstone-mask writes and
+    list_sizes decrements must bump .epoch on every return path."""
+    found = run(EPOCH.format(name="delete", body=body), ["epoch-bump"])
+    assert bool(found) == should_flag, [f.render() for f in found]
+
+
+def test_epoch_bump_delete_waiver_is_silent():
+    waived = ("    index.deleted = rows"
+              "  # analyze: epoch-bump-ok (identity mask)\n"
+              "    return index")
+    assert run(EPOCH.format(name="enable_tombstones", body=waived),
+               ["epoch-bump"]) == []
+
+
 # ---------------------------------------------------------------------------
 # lock discipline
 

@@ -104,6 +104,76 @@ class TestExtendAliasing:
                                        atol=1e-5)
 
 
+class TestLifecycleAliasing:
+    """Delete/compact are COPY-ON-WRITE (raft_tpu/lifecycle): arrays
+    read off the index before the mutation must stay valid, and a
+    cached ResultCache view must never alias post-compaction storage."""
+
+    def test_arrays_read_before_delete_survive(self, rng):
+        from raft_tpu.lifecycle import delete
+
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        data_before = index.data
+        ids_before = index.indices
+        ids_host = np.asarray(ids_before).copy()
+        delete(index, np.arange(64))
+        # the pre-delete device arrays still read back identically (the
+        # tombstone pass writes a NEW mask; storage is untouched)
+        np.testing.assert_array_equal(np.asarray(ids_before), ids_host)
+        assert index.data is data_before       # storage not even copied
+        assert index.deleted is not None
+
+    def test_arrays_read_before_compact_survive(self, rng):
+        from raft_tpu.lifecycle import compact, delete
+
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        delete(index, np.arange(128))
+        data_before = index.data
+        data_host = np.asarray(data_before).copy()
+        sizes_host = np.asarray(index.list_sizes).copy()
+        new, rep = compact(index)
+        assert new is not index                # successor, not mutation
+        # the OLD index and its arrays are fully intact (snapshot)
+        np.testing.assert_array_equal(np.asarray(data_before), data_host)
+        np.testing.assert_array_equal(np.asarray(index.list_sizes),
+                                      sizes_host)
+        assert index.n_deleted == 128 and new.n_deleted == 0
+
+    def test_cached_result_never_aliases_post_compaction_storage(self,
+                                                                 rng):
+        from raft_tpu.lifecycle import delete
+        from raft_tpu.serve import (BatchPolicy, BatchScheduler,
+                                    BucketGrid, ResultCache, Searcher)
+
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=8, engine="scan"))
+        cache = ResultCache(8)
+        sched = BatchScheduler(
+            searcher, BucketGrid.pow2(8, k_grid=(5,)),
+            BatchPolicy(max_batch=8, max_wait=0.0), cache=cache)
+        q = db[:4]
+        t = sched.submit(q, 5)
+        sched.run_until_idle()
+        res = t.result()
+        d_copy = res.distances.copy()
+        i_copy = res.indices.copy()
+        searcher.delete(np.arange(256))
+        searcher.compact()
+        # the held result is a host copy — bitwise stable across the
+        # delete + compaction publish, never a view of index storage
+        np.testing.assert_array_equal(res.distances, d_copy)
+        np.testing.assert_array_equal(res.indices, i_copy)
+        assert len(cache) == 0                 # and the entry is dead
+        sched.close()
+
+
 class TestProbeSkewCells:
     """Adversarial probe maps for the packed-cells inversion: every
     (query, probe) pair must be scanned whatever the skew (the legacy

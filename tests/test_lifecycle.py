@@ -1,0 +1,757 @@
+"""Mutable index lifecycle (raft_tpu/lifecycle) acceptance suite.
+
+The ISSUE-8 contracts: (a) EXACTNESS — after delete (before any
+compaction) results over the survivors are bit-identical to an index
+rebuilt without the deleted rows, across single-host/sharded x merge
+engines; (b) upsert applies under ONE epoch bump and never serves two
+rows for one id; (c) compaction publishes copy-on-write (pure
+reclamation preserves results bit-identically; split/recluster
+re-balance the model); (d) racing live serving, a reader never sees a
+deleted id after the delete commits, never a stale cache hit, never an
+exception from the serving path (chaos lane); (e) delete-masked and
+post-compaction serving run steady-state with zero implicit transfers
+and zero recompiles (sanitized lane).
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.lifecycle import (
+    CompactionPolicy,
+    Compactor,
+    compact,
+    delete,
+    enable_tombstones,
+    tombstone_frac,
+    upsert,
+)
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.parallel.ivf import (
+    sharded_ivf_flat_build,
+    sharded_ivf_flat_search,
+    sharded_ivf_load,
+    sharded_ivf_pq_build,
+    sharded_ivf_pq_search,
+    sharded_ivf_save,
+)
+from raft_tpu.serve import (
+    BatchPolicy,
+    BatchScheduler,
+    BucketGrid,
+    ResultCache,
+    Searcher,
+    warmup,
+)
+from raft_tpu.testing.chaos import ChaosMonkey, FaultSpec, InjectedFault
+
+N_DEV = 4
+ENGINES = ("allgather", "ring", "ring_bf16")
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices())
+    assert devs.size >= N_DEV
+    return Mesh(devs[:N_DEV], ("data",))
+
+
+def _db(seed, n=2048, dim=24):
+    return np.random.default_rng(seed).normal(size=(n, dim)).astype(
+        np.float32)
+
+
+def _no_deleted(indices, dels):
+    return not np.intersect1d(np.asarray(indices).ravel(),
+                              np.asarray(dels)).size
+
+
+# ---------------------------------------------------------------------------
+# Exactness: tombstoned index == rebuilt-without-the-rows index
+
+
+class TestDeleteExactness:
+    @pytest.mark.parametrize("engine", ["scan", "bucketed"])
+    def test_flat_single_host_matches_rebuilt(self, engine):
+        db = _db(10)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        index = ivf_flat.build(params, db)
+        dels = np.arange(0, 2048, 17)          # 121 scattered rows
+        assert delete(index, dels) == dels.size
+        # Same deterministic coarse model, survivors only, original ids.
+        rebuilt = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4,
+                                 add_data_on_build=False), db)
+        surv = np.setdiff1d(np.arange(2048), dels)
+        rebuilt = ivf_flat.extend(rebuilt, db[surv], surv.astype(np.int32))
+        sp = ivf_flat.SearchParams(n_probes=16, engine=engine)
+        q = db[dels[:16]]                      # probe FOR the deleted rows
+        d1, i1 = ivf_flat.search(sp, index, q, 10)
+        d2, i2 = ivf_flat.search(sp, rebuilt, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        assert _no_deleted(i1, dels)
+
+    @pytest.mark.parametrize("engine", ["scan", "bucketed"])
+    def test_pq_single_host_matches_rebuilt(self, engine):
+        db = _db(11, dim=32)
+        mk = lambda add: ivf_pq.IndexParams(
+            n_lists=16, pq_dim=16, kmeans_n_iters=4, add_data_on_build=add)
+        index = ivf_pq.build(mk(True), db)
+        dels = np.arange(0, 2048, 13)
+        assert delete(index, dels) == dels.size
+        rebuilt = ivf_pq.build(mk(False), db)
+        surv = np.setdiff1d(np.arange(2048), dels)
+        rebuilt = ivf_pq.extend(rebuilt, db[surv], surv.astype(np.int32))
+        sp = ivf_pq.SearchParams(n_probes=16, engine=engine)
+        q = db[dels[:16]]
+        d1, i1 = ivf_pq.search(sp, index, q, 10)
+        d2, i2 = ivf_pq.search(sp, rebuilt, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+        assert _no_deleted(i1, dels)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_sharded_flat_matches_rebuilt(self, mesh4, engine):
+        db = _db(12)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        model = ivf_flat.build(params, db)
+        index = sharded_ivf_flat_build(mesh4, params, db,
+                                       centers=model.centers)
+        dels = np.arange(0, 2048, 11)[:120]    # survivor count % 4 == 0
+        assert delete(index, dels, mesh=mesh4) == dels.size
+        surv = np.setdiff1d(np.arange(2048), dels)
+        rebuilt = sharded_ivf_flat_build(mesh4, params, db[surv],
+                                         centers=model.centers)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        q = db[dels[:16]]
+        d1, i1 = sharded_ivf_flat_search(mesh4, sp, index, q, 10,
+                                         merge_engine=engine)
+        d2, i2 = sharded_ivf_flat_search(mesh4, sp, rebuilt, q, 10,
+                                         merge_engine=engine)
+        # rebuilt ids are its own row numbering — map back to global ids
+        np.testing.assert_array_equal(np.asarray(i1),
+                                      surv[np.asarray(i2)])
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        assert _no_deleted(i1, dels)
+
+    @pytest.mark.parametrize("engine", ENGINES)
+    def test_sharded_pq_matches_rebuilt(self, mesh4, engine):
+        db = _db(13, dim=32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                    kmeans_n_iters=4,
+                                    add_data_on_build=False)
+        model = ivf_pq.build(params, db)
+        index = sharded_ivf_pq_build(mesh4, params, db, model=model)
+        dels = np.arange(0, 2048, 11)[:120]
+        assert delete(index, dels, mesh=mesh4) == dels.size
+        surv = np.setdiff1d(np.arange(2048), dels)
+        rebuilt = sharded_ivf_pq_build(mesh4, params, db[surv],
+                                       model=model)
+        sp = ivf_pq.SearchParams(n_probes=16)
+        q = db[dels[:16]]
+        d1, i1 = sharded_ivf_pq_search(mesh4, sp, index, q, 10,
+                                       merge_engine=engine)
+        d2, i2 = sharded_ivf_pq_search(mesh4, sp, rebuilt, q, 10,
+                                       merge_engine=engine)
+        np.testing.assert_array_equal(np.asarray(i1),
+                                      surv[np.asarray(i2)])
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        assert _no_deleted(i1, dels)
+
+    def test_delete_matches_brute_force_truth_over_survivors(self):
+        """Full-probe tombstoned IVF-Flat == exact brute force over the
+        survivor rows (the no-recall-cliff guarantee)."""
+        from raft_tpu.neighbors import brute_force
+
+        db = _db(14, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        dels = np.arange(0, 1024, 7)
+        delete(index, dels)
+        surv = np.setdiff1d(np.arange(1024), dels)
+        q = db[dels[:8]]
+        d1, i1 = ivf_flat.search(
+            ivf_flat.SearchParams(n_probes=8, engine="scan"), index, q, 5)
+        dt, it = brute_force.knn(db[surv], q, 5)
+        np.testing.assert_array_equal(np.asarray(i1),
+                                      surv[np.asarray(it)])
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(dt),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_redelete_is_idempotent_and_unknown_ids_ignored(self):
+        db = _db(15, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        assert delete(index, [3, 5]) == 2
+        e = index.epoch
+        assert delete(index, [3, 5]) == 0      # already tombstoned
+        assert delete(index, [99999]) == 0     # never existed
+        assert index.epoch == e                # no-op deletes don't bump
+        assert index.n_deleted == 2
+        assert abs(tombstone_frac(index) - 2 / 512) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# Upsert
+
+
+class TestUpsert:
+    def test_single_bump_and_no_duplicate_ids(self):
+        db = _db(20, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        sp = ivf_flat.SearchParams(n_probes=8, engine="scan")
+        e0 = index.epoch
+        newv = (db[40:44] + 10.0).astype(np.float32)
+        index = upsert(index, newv, np.arange(40, 44))
+        assert index.epoch == e0 + 1           # ONE bump for the pair
+        # the new vectors answer under their ids...
+        d, i = ivf_flat.search(sp, index, newv, 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(40, 44))
+        np.testing.assert_allclose(np.asarray(d)[:, 0], 0.0, atol=1e-2)
+        # ...the old vectors no longer do, and no id is served twice.
+        d2, i2 = ivf_flat.search(sp, index, db[40:44], 10)
+        for row in np.asarray(i2):
+            live = row[row >= 0]
+            assert len(set(live.tolist())) == len(live)
+        old_d, _ = ivf_flat.search(sp, index, db[40:41], 1)
+        assert float(np.asarray(old_d)[0, 0]) > 1e-3  # old row is gone
+
+    def test_pure_insert_via_upsert(self):
+        db = _db(21, n=512, dim=8)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=2), db)
+        newv = _db(22, n=4, dim=8)
+        index = upsert(index, newv, np.array([9000, 9001, 9002, 9003]))
+        assert index.n_deleted == 0            # nothing tombstoned
+        sp = ivf_pq.SearchParams(n_probes=8, engine="scan")
+        _, i = ivf_pq.search(sp, index, newv, 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(9000, 9004))
+
+    def test_sharded_upsert(self, mesh4):
+        db = _db(23)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        e0 = index.epoch
+        newv = (db[8:12] + 5.0).astype(np.float32)
+        index = upsert(index, newv, np.arange(8, 12), mesh=mesh4)
+        assert index.epoch == e0 + 1
+        sp = ivf_flat.SearchParams(n_probes=8)
+        d, i = sharded_ivf_flat_search(mesh4, sp, index, newv, 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(8, 12))
+
+    def test_duplicate_ids_rejected(self):
+        db = _db(24, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        with pytest.raises(Exception, match="unique"):
+            upsert(index, _db(25, n=2, dim=8), np.array([7, 7]))
+
+    def test_invalid_input_leaves_index_untouched(self, mesh4):
+        """Validation precedes the tombstone write: a rejected upsert
+        must not leave a half-mutated (rows-deleted, epoch-unchanged)
+        index behind."""
+        db = _db(26, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        e0 = index.epoch
+        with pytest.raises(Exception, match="dim"):
+            upsert(index, _db(27, n=2, dim=16), np.array([1, 2]))
+        assert index.epoch == e0 and index.n_deleted == 0
+        sh = sharded_ivf_flat_build(
+            mesh4, ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2),
+            _db(28, n=512, dim=8))
+        e0 = sh.epoch
+        with pytest.raises(Exception, match="divide"):
+            upsert(sh, _db(29, n=3, dim=8), np.array([1, 2, 3]),
+                   mesh=mesh4)
+        assert sh.epoch == e0 and sh.n_deleted == 0
+
+    def test_noop_delete_on_fresh_index_changes_nothing(self):
+        """A no-match delete on a mask-free index must neither attach
+        the mask (trace switch) nor bump the epoch (cache wipe)."""
+        db = _db(28, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        e0 = index.epoch
+        assert delete(index, [99999]) == 0
+        assert index.deleted is None and index.epoch == e0
+
+    def test_enable_tombstones_survives_bulk_extend(self):
+        """The pre-attached identity mask (masked-trace warmup story)
+        must survive the fresh-fill extend branch."""
+        db = _db(29, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2,
+                                 add_data_on_build=False), db)
+        enable_tombstones(index)
+        index = ivf_flat.extend(index, db)     # bulk path (size was 0)
+        assert index.deleted is not None
+        assert index.deleted.shape == index.indices.shape
+        assert index.n_deleted == 0
+
+
+# ---------------------------------------------------------------------------
+# Auto-id allocation (satellite regression)
+
+
+class TestAutoIdAllocation:
+    def test_default_ids_after_explicit_extend_do_not_collide(self):
+        db = _db(30, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        index = ivf_flat.extend(index, _db(31, n=4, dim=8),
+                                np.array([600, 601, 602, 603]))
+        index = ivf_flat.extend(index, _db(32, n=4, dim=8))  # auto ids
+        ids = np.asarray(index.indices).ravel()
+        ids = ids[ids >= 0]
+        assert len(ids) == len(set(ids.tolist()))
+        assert ids.max() == 607                # 604..607, not 516..519
+
+    def test_default_ids_after_delete_do_not_reuse_live_ids(self):
+        db = _db(33, n=512, dim=8)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, pq_dim=8, kmeans_n_iters=2), db)
+        delete(index, np.arange(64))
+        index = ivf_pq.extend(index, _db(34, n=8, dim=8))   # auto ids
+        ids = np.asarray(index.indices).ravel()
+        ids = ids[ids >= 0]
+        assert len(ids) == len(set(ids.tolist()))
+        assert ids.max() == 519                # continues past 511
+
+    def test_sharded_resolve_new_ids_uses_max_id(self, mesh4):
+        db = _db(35)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        from raft_tpu.parallel.ivf import sharded_ivf_flat_extend
+
+        index = sharded_ivf_flat_extend(mesh4, index, _db(36, n=4),
+                                        np.array([9000, 9001, 9002, 9003]))
+        index = sharded_ivf_flat_extend(mesh4, index, _db(37, n=4))
+        ids = np.asarray(index.indices).ravel()
+        ids = ids[ids >= 0]
+        assert len(ids) == len(set(ids.tolist()))
+        assert ids.max() == 9007
+
+    def test_loaded_index_derives_base_from_stored_ids(self, tmp_path):
+        db = _db(38, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        index = ivf_flat.extend(index, _db(39, n=2, dim=8),
+                                np.array([800, 801]))
+        f = str(tmp_path / "idx.npz")
+        ivf_flat.save(f, index)
+        loaded = ivf_flat.load(f)
+        loaded = ivf_flat.extend(loaded, _db(40, n=2, dim=8))
+        ids = np.asarray(loaded.indices).ravel()
+        ids = ids[ids >= 0]
+        assert len(ids) == len(set(ids.tolist()))
+        assert ids.max() == 803
+
+
+# ---------------------------------------------------------------------------
+# Compaction
+
+
+class TestCompaction:
+    def test_reclaim_preserves_results_bit_identically(self):
+        db = _db(50)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4), db)
+        dels = np.arange(0, 2048, 9)
+        delete(index, dels)
+        sp = ivf_flat.SearchParams(n_probes=16, engine="scan")
+        q = _db(51, n=32)
+        d1, i1 = ivf_flat.search(sp, index, q, 10)
+        new, rep = compact(index)
+        assert rep.reclaimed_slots == dels.size
+        assert new.n_deleted == 0 and new.deleted is None
+        assert new.epoch == index.epoch + 1
+        assert new.data.shape == index.data.shape   # keep-cap default
+        d2, i2 = ivf_flat.search(sp, new, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_shrink_capacity_reclaims_hbm(self):
+        db = _db(52, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        delete(index, np.arange(0, 1024, 2))       # half the rows
+        new, rep = compact(index, CompactionPolicy(shrink_capacity=True))
+        assert rep.cap_after <= rep.cap_before
+        assert new.size == 512
+        sp = ivf_flat.SearchParams(n_probes=8, engine="scan")
+        surv = np.arange(1, 1024, 2)
+        _, i = ivf_flat.search(sp, new, db[surv[:16]], 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0], surv[:16])
+
+    def test_pq_reclaim_preserves_results(self):
+        db = _db(53, dim=32)
+        index = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, pq_dim=16, kmeans_n_iters=4),
+            db)
+        delete(index, np.arange(0, 2048, 5))
+        sp = ivf_pq.SearchParams(n_probes=16, engine="scan")
+        q = _db(54, n=16, dim=32)
+        d1, i1 = ivf_pq.search(sp, index, q, 10)
+        new, rep = compact(index)
+        d2, i2 = ivf_pq.search(sp, new, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_allclose(np.asarray(d1), np.asarray(d2))
+
+    def test_sharded_reclaim_preserves_results(self, mesh4):
+        db = _db(55)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        delete(index, np.arange(0, 2048, 6), mesh=mesh4)
+        sp = ivf_flat.SearchParams(n_probes=8)
+        q = _db(56, n=16)
+        d1, i1 = sharded_ivf_flat_search(mesh4, sp, index, q, 10)
+        new, rep = compact(index, mesh=mesh4)
+        assert new.indices.shape == index.indices.shape  # keep-cap
+        d2, i2 = sharded_ivf_flat_search(mesh4, sp, new, q, 10)
+        np.testing.assert_array_equal(np.asarray(i1), np.asarray(i2))
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+    def test_split_rebalances_hot_list(self):
+        rng = np.random.default_rng(57)
+        base = rng.normal(size=(1024, 16)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=6), base)
+        hot = (np.asarray(index.centers)[0]
+               + 0.1 * rng.normal(size=(2048, 16))).astype(np.float32)
+        index = ivf_flat.extend(index, hot)
+        before = int(np.asarray(index.list_sizes).max())
+        new, rep = compact(index, CompactionPolicy(
+            split_above=2.0, shrink_capacity=True))
+        assert rep.lists_split >= 1
+        assert rep.n_lists_after > rep.n_lists_before
+        after = int(np.asarray(new.list_sizes).max())
+        assert after < before                  # the hot list was cut
+        # nothing lost: every row still finds itself with full probes
+        allrows = np.concatenate([base, hot])
+        sp = ivf_flat.SearchParams(n_probes=new.n_lists, engine="scan")
+        _, i = ivf_flat.search(sp, new, allrows[1000:1032], 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(1000, 1032))
+
+    def test_recluster_snaps_drifted_center(self):
+        rng = np.random.default_rng(58)
+        base = rng.normal(size=(1024, 16)).astype(np.float32)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=6), base)
+        c0 = np.asarray(index.centers)[0]
+        drifted = (c0 + 3.0
+                   + 0.2 * rng.normal(size=(512, 16))).astype(np.float32)
+        index = ivf_flat.extend(index, drifted)
+        new, rep = compact(index, CompactionPolicy(drift_threshold=0.5))
+        assert rep.lists_reclustered >= 1
+        sp = ivf_flat.SearchParams(n_probes=new.n_lists, engine="scan")
+        allrows = np.concatenate([base, drifted])
+        _, i = ivf_flat.search(sp, new, allrows[1024:1056], 1)
+        np.testing.assert_array_equal(np.asarray(i)[:, 0],
+                                      np.arange(1024, 1056))
+
+    def test_noop_when_nothing_to_do(self):
+        db = _db(59, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        same, rep = compact(index)
+        assert rep is None and same is index
+
+    def test_compactor_trigger_and_searcher_publish(self):
+        db = _db(60, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=8, engine="scan"))
+        cache = ResultCache(16)
+        unhook = searcher.add_invalidation_hook(cache.invalidate)
+        comp = Compactor(searcher, CompactionPolicy(trigger_frac=0.25))
+        assert comp.run_once() is None         # below trigger
+        searcher.delete(np.arange(300))        # ~29% tombstoned
+        e0 = searcher.epoch
+        cache.put(e0, db[:1], 5, "sentinel-entry")
+        rep = comp.run_once()
+        assert rep is not None and rep.reclaimed_slots == 300
+        assert searcher.epoch == e0 + 1        # publish bumped once
+        assert len(cache) == 0                 # hooks invalidated it
+        assert searcher._index.n_deleted == 0
+        assert comp.passes == 1
+        unhook()
+
+    def test_compactor_background_thread(self):
+        db = _db(61, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=4, engine="scan"))
+        searcher.delete(np.arange(200))
+        ran = threading.Event()
+
+        def tick_sleep(_):
+            ran.set()
+
+        comp = Compactor(searcher, CompactionPolicy(trigger_frac=0.1),
+                         interval=0.0, sleep=tick_sleep)
+        comp.start()
+        comp.start()                            # idempotent
+        assert ran.wait(timeout=5.0)
+        comp.stop()
+        comp.stop()                             # idempotent
+        assert searcher._index.n_deleted == 0 and comp.passes >= 1
+
+
+# ---------------------------------------------------------------------------
+# Persistence round trips
+
+
+class TestLifecyclePersistence:
+    def test_flat_save_load_keeps_tombstones(self, tmp_path):
+        db = _db(70, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        dels = np.arange(16)
+        delete(index, dels)
+        f = str(tmp_path / "t.npz")
+        ivf_flat.save(f, index)
+        loaded = ivf_flat.load(f)
+        assert loaded.n_deleted == 16
+        sp = ivf_flat.SearchParams(n_probes=4, engine="scan")
+        _, i = ivf_flat.search(sp, loaded, db[:8], 5)
+        assert _no_deleted(i, dels)
+
+    def test_sharded_save_load_keeps_tombstones(self, mesh4, tmp_path):
+        db = _db(71)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        dels = np.arange(32)
+        delete(index, dels, mesh=mesh4)
+        base = str(tmp_path / "sh")
+        sharded_ivf_save(base, index)
+        loaded = sharded_ivf_load(mesh4, base)
+        assert loaded.n_deleted == 32
+        sp = ivf_flat.SearchParams(n_probes=8)
+        _, i = sharded_ivf_flat_search(mesh4, sp, loaded, db[:8], 5)
+        assert _no_deleted(i, dels)
+
+
+# ---------------------------------------------------------------------------
+# Chaos: lifecycle racing live serving
+
+
+@pytest.mark.chaos
+class TestLifecycleChaos:
+    def test_seeded_interleaving_never_serves_deleted_or_stale(self):
+        """Deterministic seeded schedule of delete/upsert/compact
+        interleaved with scheduler traffic (cache on): every search
+        completed after a mutation commits reflects it — no deleted id,
+        no stale cache hit, no exception from the serving path."""
+        rng = np.random.default_rng(80)
+        db = _db(81, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=8, engine="scan"))
+        grid = BucketGrid.pow2(8, k_grid=(5,))
+        sched = BatchScheduler(searcher, grid,
+                               BatchPolicy(max_batch=8, max_wait=0.0),
+                               cache=ResultCache(64))
+        qfix = db[512:516]                     # the repeated (cached) query
+        live = set(range(1024))
+        deleted = set()
+        next_id = 1024
+        for step in range(30):
+            op = rng.integers(0, 4)
+            if op == 0 and len(live) > 64:
+                victims = rng.choice(sorted(live), size=4, replace=False)
+                n = searcher.delete(victims)
+                assert n == 4
+                live -= set(int(v) for v in victims)
+                deleted |= set(int(v) for v in victims)
+            elif op == 1:
+                ids = np.array([next_id, next_id + 1])
+                next_id += 2
+                searcher.upsert(rng.normal(size=(2, 16)).astype(np.float32),
+                                ids)
+                live |= set(int(v) for v in ids)
+            elif op == 2 and searcher.tombstone_frac > 0.02:
+                searcher.compact()
+            # traffic after the mutation committed:
+            t1 = sched.submit(
+                rng.normal(size=(2, 16)).astype(np.float32), 5)
+            t2 = sched.submit(qfix, 5)
+            sched.run_until_idle()
+            for t in (t1, t2):
+                res = t.result()               # never raises
+                served = set(int(v) for v in res.indices.ravel()
+                             if v >= 0)
+                assert not served & deleted, (step, served & deleted)
+        sched.close()
+
+    def test_compaction_fault_publishes_nothing(self):
+        """A fault between building the successor index and the publish
+        swap (the ChaosMonkey pre_publish hook) must leave the serving
+        index, its epoch and its tombstones untouched; the retry then
+        publishes cleanly."""
+        db = _db(82, n=512, dim=8)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=4, kmeans_n_iters=2), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=4, engine="scan"))
+        searcher.delete(np.arange(64))
+        chaos = ChaosMonkey(seed=0)
+        chaos.script("compact.publish",
+                     [FaultSpec(kind="raise", at=(0,))])
+        comp = Compactor(searcher, CompactionPolicy(trigger_frac=0.01),
+                         pre_publish=chaos.hook("compact.publish"))
+        e0, idx0 = searcher.epoch, searcher._index
+        with pytest.raises(InjectedFault):
+            comp.run_once()
+        assert searcher.epoch == e0 and searcher._index is idx0
+        assert searcher._index.n_deleted == 64
+        rep = comp.run_once()                  # call index 1: no fault
+        assert rep is not None and rep.reclaimed_slots == 64
+        assert searcher.epoch == e0 + 1 and chaos.calls(
+            "compact.publish") == 2
+
+    def test_threaded_serving_during_mutations(self):
+        """A pump thread serving traffic while the main thread deletes,
+        upserts and compacts: the serving path never raises and the
+        final state reflects every mutation."""
+        db = _db(83, n=1024, dim=16)
+        index = ivf_flat.build(
+            ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4), db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=8, engine="scan"))
+        grid = BucketGrid.pow2(8, k_grid=(5,))
+        sched = BatchScheduler(searcher, grid,
+                               BatchPolicy(max_batch=8, max_wait=0.0),
+                               cache=ResultCache(32))
+        rng = np.random.default_rng(84)
+        errors = []
+        done = threading.Event()
+
+        def serve_loop():
+            try:
+                r = np.random.default_rng(85)
+                while not done.is_set():
+                    t = sched.submit(
+                        r.normal(size=(2, 16)).astype(np.float32), 5)
+                    sched.run_until_idle()
+                    t.result()
+            except Exception as e:             # pragma: no cover
+                errors.append(e)
+
+        th = threading.Thread(target=serve_loop, daemon=True)
+        th.start()
+        try:
+            for i in range(8):
+                searcher.delete(np.arange(i * 8, i * 8 + 8))
+                searcher.upsert(
+                    rng.normal(size=(2, 16)).astype(np.float32),
+                    np.array([2000 + 2 * i, 2001 + 2 * i]))
+                if searcher.tombstone_frac > 0.05:
+                    searcher.compact()
+        finally:
+            done.set()
+            th.join(timeout=10.0)
+        sched.close()
+        assert not errors, errors
+        # live rows reflect every mutation exactly: 1024 - 64 deleted
+        # + 16 pure-insert upserts, whatever the compaction timing.
+        assert searcher._index.live_size == 1024 - 64 + 16
+        res = searcher.search(db[:4], 5)
+        assert not np.intersect1d(res.indices.ravel(),
+                                  np.arange(64)).size
+
+
+# ---------------------------------------------------------------------------
+# Sanitized: zero implicit transfers, zero steady-state compiles
+
+
+@pytest.mark.sanitized
+def test_delete_masked_sharded_search_steady_state(mesh4, sanitizer_lane):
+    """After the masked trace is warm, further deletes mutate mask
+    VALUES only: searches trip no transfer guard and compile nothing —
+    the tombstone mask must not introduce a compile per delete."""
+    rng = np.random.default_rng(90)
+    with sanitizer_lane.allow_transfers():     # builds are not a hot path
+        db = rng.normal(size=(256, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        enable_tombstones(index, mesh=mesh4)
+    sp = ivf_flat.SearchParams(n_probes=8)
+    q = rng.normal(size=(8, 16)).astype(np.float32)
+    # warm: the tombstone program and the masked search trace
+    assert delete(index, np.arange(4), mesh=mesh4) == 4
+    sharded_ivf_flat_search(mesh4, sp, index, q, 5)
+    sanitizer_lane.mark_steady()
+
+    dels2 = np.arange(4, 8)                    # same pow2 batch width
+    assert delete(index, dels2, mesh=mesh4) == 4
+    d, i = jax.device_get(
+        sharded_ivf_flat_search(mesh4, sp, index,
+                                rng.normal(size=(8, 16)).astype(
+                                    np.float32), 5))
+    assert not np.intersect1d(i.ravel(), np.arange(8)).size
+    assert sanitizer_lane.steady_compiles == 0
+
+
+@pytest.mark.sanitized
+def test_post_compaction_serving_steady_state(mesh4, sanitizer_lane):
+    """Compaction with the keep-capacity default publishes tensors of
+    identical shapes: post-publish serving reuses the warmed traces —
+    zero transfers tripped, zero compiles."""
+    rng = np.random.default_rng(91)
+    with sanitizer_lane.allow_transfers():
+        db = rng.normal(size=(256, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        searcher = Searcher.ivf_flat(
+            index, ivf_flat.SearchParams(n_probes=8), mesh=mesh4)
+    q = rng.normal(size=(8, 16)).astype(np.float32)
+    searcher.search(q, 5)                      # warm the mask-free trace
+    with sanitizer_lane.allow_transfers():     # control plane, not serving
+        searcher.delete(np.arange(16))
+    searcher.search(q, 5)                      # warm the masked trace
+    with sanitizer_lane.allow_transfers():     # background pass (host syncs)
+        rep = searcher.compact()
+        assert rep is not None and rep.cap_after == rep.cap_before
+    sanitizer_lane.mark_steady()
+
+    # post-compaction: deleted=None again -> the warmed mask-free trace
+    res = searcher.search(
+        rng.normal(size=(8, 16)).astype(np.float32), 5)
+    assert not np.intersect1d(res.indices.ravel(), np.arange(16)).size
+    assert res.distances.shape == (8, 5)
+    assert sanitizer_lane.steady_compiles == 0
+
+
+# ---------------------------------------------------------------------------
+# Bench family smoke (tier-1 keeps the harness from rotting)
+
+
+def test_lifecycle_bench_smoke(capsys):
+    import json
+
+    from bench.lifecycle import run
+
+    run(quick=True)
+    rows = [json.loads(l) for l in
+            capsys.readouterr().out.splitlines() if l.strip()]
+    metrics = {r["metric"] for r in rows}
+    assert "lifecycle_churn_rows_per_s" in metrics
+    assert "lifecycle_search_qps_tombstoned" in metrics
+    assert "lifecycle_compact_s" in metrics
+    assert "lifecycle_serve_p99_ms" in metrics
+    for r in rows:
+        assert r["value"] >= 0.0
