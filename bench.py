@@ -588,6 +588,17 @@ def _family_lifecycle():
     run(quick=False)
 
 
+def _family_analyze():
+    """Static-gate metrics (ISSUE 9): full-tree graft-analyze wall
+    time cold (fresh cache) vs warm (incremental cache hit) and the
+    resulting speedup — the gate runs on every CI invocation, so its
+    cost is tracked like any hot path.  Body lives in bench/analyze.py
+    (shared with the tier-1 smoke test)."""
+    from bench.analyze import run
+
+    run(quick=False)
+
+
 def _family_sharded():
     """Merge-engine metrics for the sharded search paths (ISSUE 1): QPS +
     estimated per-device exchange bytes per engine (allgather | ring |
@@ -694,6 +705,7 @@ def main():
 
     enable_compilation_cache()
     _run_family(_family, "bench_family_error")
+    _run_family(_family_analyze, "bench_analyze_error")
     if "--no-1m" not in sys.argv:
         _run_family(_family_sharded, "bench_sharded_error")
         _run_family(_family_serve, "bench_serve_error")

@@ -1,0 +1,82 @@
+"""Static-gate bench family (ISSUE 9 satellite).
+
+Measures the analyzer itself, bench.py-style one-JSON-row-per-metric —
+the gate runs on every CI invocation and twice per build.sh target, so
+its wall time is a tracked surface like any other hot path:
+
+* ``analyze_cold_s`` — full-tree graft-analyze with a FRESH cache
+  directory (every module a miss, graph tier recomputed): the
+  first-run / post-analyzer-edit cost.
+* ``analyze_warm_s`` — the same tree against the now-populated cache
+  (every module a hit, graph tier replayed): the steady-state CI cost.
+* ``analyze_warm_speedup`` — cold/warm ratio, with the entry counts,
+  finding/waived totals and the full-hit bit in the extras (the smoke
+  test asserts the bit, not the timing — sandbox clocks throttle).
+
+``quick=True`` is the CI smoke shape (one warm round; tier-1 runs it
+via tests/test_analyze_cache.py).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pathlib
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _analyzer():
+    name = "graft_analyze"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(
+        name, ROOT / "ci" / "analyze.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(quick: bool = False) -> None:
+    ga = _analyzer()
+    rounds = 1 if quick else 5
+    with tempfile.TemporaryDirectory() as td:
+        cdir = pathlib.Path(td)
+
+        t0 = time.perf_counter()
+        findings, waived, cold_stats = ga.analyze_repo_cached(
+            ROOT, cache_dir=cdir)
+        cold_s = time.perf_counter() - t0
+
+        warm_s = float("inf")
+        warm_stats = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            wf, ww, warm_stats = ga.analyze_repo_cached(
+                ROOT, cache_dir=cdir)
+            warm_s = min(warm_s, time.perf_counter() - t0)
+        full_hit = (warm_stats.mod_misses == 0 and warm_stats.graph_hit
+                    and [f.render() for f in wf]
+                    == [f.render() for f in findings])
+
+    _emit("analyze_cold_s", cold_s, "s",
+          modules=cold_stats.mod_misses, findings=len(findings),
+          waived=len(waived))
+    _emit("analyze_warm_s", warm_s, "s", rounds=rounds)
+    _emit("analyze_warm_speedup", cold_s / max(warm_s, 1e-9), "x",
+          warm_full_hit=full_hit, findings=len(findings))
+
+
+if __name__ == "__main__":
+    run()

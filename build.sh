@@ -19,8 +19,10 @@ do_native() {
 do_style() {
   # Static gate (ref: ci/check_style.sh + cpp/scripts style tools):
   # style/citation checks plus the TPU tracing-safety & concurrency
-  # analyzer (docs/static_analysis.md).
-  python ci/analyze.py
+  # analyzer (docs/static_analysis.md). Incremental — warm runs
+  # replay from .analyze_cache, so the tests target pays the full
+  # analysis at most once.
+  python ci/analyze.py --stats
 }
 
 do_tests() {

@@ -47,6 +47,31 @@ lock-discipline classes owning a threading.Lock may touch their
 sentinel        merge/padding sentinels (±inf distances, -1 ids) in the
                 merge-path modules must come from
                 raft_tpu/core/sentinels.py, never re-typed literals.
+recompile-risk  outside traced code, an array extent must not derive
+                from a device value materialized to a host int
+                (``cap = int(jnp.max(counts))`` feeding
+                ``jnp.zeros((n, cap))``): every distinct value bakes a
+                fresh shape and recompiles every downstream jit
+                consumer.  Pow2 bucketing (``next_pow2``/
+                ``.bit_length()``) bounds the class count and is
+                accepted; ``.shape``-derived extents are static.
+                Inside traced code the same pull is host-sync's domain.
+
+Incremental cache
+=================
+
+Results are memoized under ``<root>/.analyze_cache`` in two tiers:
+``mod-<hash>.json`` holds one module's local-check results
+(style/cite/epoch-bump/lock-discipline/sentinel) keyed by the module's
+content, and ``graph-<hash>.json`` holds the whole-program checks
+(host-sync/axis-name/recompile-risk) keyed by every module's content —
+an interprocedural finding may move when ANY module changes, so the
+graph tier is all-or-nothing.  Both keys fold in a fingerprint of the
+analyzer's own sources, so editing the analyzer invalidates everything.
+The cache is pure memoization: a warm run returns bit-identical
+findings (tests/test_analyze_cache.py proves parity), corrupt entries
+are re-analyzed, and the directory self-prunes.  ``--no-cache``
+bypasses it.
 
 Waivers
 =======
@@ -63,11 +88,14 @@ central exemption table: exemptions live with the code.
 Usage
 =====
 
-    python ci/analyze.py                  # whole tree, all checks
+    python ci/analyze.py                  # whole tree, all checks, cached
     python ci/analyze.py --check host-sync --check sentinel
+    python ci/analyze.py --no-cache --stats --show-waived
     python ci/analyze.py --list-checks
 
-Exit code 0 = clean, 1 = findings (printed one per line).
+Exit code 0 = clean, 1 = findings (printed one per line).  ``--stats``
+adds a cache/waiver summary line; ``--show-waived`` prints the waived
+findings (informational, never affect the exit code).
 """
 
 from __future__ import annotations
@@ -84,7 +112,14 @@ ROOT = Path(__file__).resolve().parent.parent
 SCAN = ["raft_tpu", "pylibraft", "raft_dask", "tests", "bench", "ci"]
 
 CHECKS = ("style", "cite", "host-sync", "axis-name", "epoch-bump",
-          "lock-discipline", "sentinel")
+          "lock-discipline", "sentinel", "recompile-risk")
+
+# Cache tiers: a LOCAL check reads one module in isolation, so its
+# results key on that module's content alone; a GRAPH check walks the
+# interprocedural call graph, so its results key on every module.
+LOCAL_CHECKS = ("style", "cite", "epoch-bump", "lock-discipline",
+                "sentinel")
+GRAPH_CHECKS = ("host-sync", "axis-name", "recompile-risk")
 
 # Semantic findings are emitted for the library tree only (the whole
 # tree still feeds the call graph, so tests/bench wrappers count for
@@ -125,6 +160,13 @@ HOF_CALLBACKS = {"scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
                  "checkpoint": (0,), "remat": (0,)}
 CONTAINER_CTORS = {"list", "dict", "set", "deque", "OrderedDict",
                    "defaultdict"}
+# recompile-risk: jax constructors whose first argument is a shape.
+SHAPE_CTORS = {"zeros", "ones", "full", "empty"}
+# Bucketing sanitizers: pow2 rounding bounds the capacity-class count
+# to log-many, the deliberate design of serve/bucketing — extents
+# laundered through these do NOT count as data-dependent.
+BUCKET_FNS = {"next_pow2"}
+BUCKET_METHODS = {"bit_length"}
 CAST_BUILTINS = {"float", "int", "bool"}
 SAFE_BUILTINS = {"len", "isinstance", "range", "type", "repr", "str",
                  "print", "format", "hasattr", "id", "sorted", "zip",
@@ -354,6 +396,9 @@ class Analyzer:
         self.wrapped: Set[FuncInfo] = set()
         self.traced_params: Dict[FuncInfo, Set[str]] = {}
         self._files = files
+        self.waived: List[Finding] = []
+        self._seen_waived: Set[Tuple] = set()
+        self._graph_built = False
 
     def _load(self, rel: str, text: str) -> None:
         try:
@@ -379,9 +424,17 @@ class Analyzer:
         if prev and line - 2 < len(mod.lines) and \
                 mod.lines[line - 2].lstrip().startswith("#"):
             waived |= prev
-        if check in waived:
-            return
         key = (mod.rel, line, check, msg)
+        if check in waived:
+            # Waived findings are recorded (cache / --show-waived /
+            # --stats surface them) but never affect the exit code.
+            # Deduped per site — one waiver comment, one record, even
+            # when several return paths would re-derive the finding.
+            wkey = (mod.rel, line, check)
+            if wkey not in self._seen_waived:
+                self._seen_waived.add(wkey)
+                self.waived.append(Finding(mod.rel, line, check, msg))
+            return
         if key in self._seen:
             return
         self._seen.add(key)
@@ -501,7 +554,12 @@ class Analyzer:
 
     def build_graph(self) -> None:
         """Wrapper bodies (shard_map/pmap, incl. forwarders), HOF
-        callbacks, the traced set and the wrapped-reachable set."""
+        callbacks, the traced set and the wrapped-reachable set.
+        Idempotent — ``run()`` may be invoked once for the local tier
+        and once for the graph tier without rebuilding."""
+        if self._graph_built:
+            return
+        self._graph_built = True
         bodies: Set[FuncInfo] = set()
         hof: Set[FuncInfo] = set()
         forwarders: Dict[FuncInfo, Set[str]] = {}
@@ -1005,8 +1063,8 @@ class Analyzer:
         return bound
 
     # -- epoch-bump --------------------------------------------------------
-    def run_epoch(self) -> None:
-        for mod in self.modules.values():
+    def run_epoch(self, mods=None) -> None:
+        for mod in (mods if mods is not None else self.modules.values()):
             if not mod.rel.startswith(SEMANTIC_SCOPE):
                 continue
             for fi in mod.funcs:
@@ -1102,8 +1160,8 @@ class Analyzer:
                 break
 
     # -- lock-discipline ---------------------------------------------------
-    def run_lock(self) -> None:
-        for mod in self.modules.values():
+    def run_lock(self, mods=None) -> None:
+        for mod in (mods if mods is not None else self.modules.values()):
             if not mod.rel.startswith(SEMANTIC_SCOPE):
                 continue
             for node in ast.walk(mod.tree):
@@ -1221,8 +1279,8 @@ class Analyzer:
                 return True
         return False
 
-    def run_sentinel(self) -> None:
-        for mod in self.modules.values():
+    def run_sentinel(self, mods=None) -> None:
+        for mod in (mods if mods is not None else self.modules.values()):
             if mod.rel == SENTINEL_HOME or \
                     not any(mod.rel.startswith(p) or mod.rel == p
                             for p in SENTINEL_SCOPE):
@@ -1292,9 +1350,176 @@ class Analyzer:
                             "-1 pad sentinel in constant_values — use "
                             "raft_tpu.core.sentinels.PAD_ID")
 
-    # -- style / cite ------------------------------------------------------
-    def run_style(self) -> None:
+    # -- recompile-risk ----------------------------------------------------
+    def run_recompile_risk(self) -> None:
+        """Eager (untraced) code that materializes a device value to a
+        host int and feeds it into an array EXTENT: every distinct
+        value bakes a fresh shape, so every downstream jit consumer
+        recompiles per value.  Traced functions are excluded — there
+        the int() itself is host-sync's finding."""
         for mod in self.modules.values():
+            if not mod.rel.startswith(SEMANTIC_SCOPE):
+                continue
+            for fi in mod.funcs:
+                if fi in self.traced or isinstance(fi.node, ast.Lambda):
+                    continue
+                self._recompile_fn(fi)
+
+    def _is_device_expr(self, e, fi: FuncInfo) -> bool:
+        """Any jax.* call in the subtree — the value lives on device."""
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call):
+                ext = self._ext_of(
+                    self.call_targets(n.func, fi, fi.module))
+                if ext and ext.startswith("jax"):
+                    return True
+        return False
+
+    def _dyn_extent(self, e, dyn: Dict[str, int],
+                    fi: FuncInfo) -> Optional[int]:
+        """Origin line if ``e`` carries a data-dependent host scalar
+        (a device value pulled through int()/float()), else None.
+        ``.shape``-family attributes are static; pow2 bucketing
+        (next_pow2 / .bit_length) bounds the class count and
+        sanitizes; jax calls yield device values (not host extents);
+        resolved library functions are a deliberate boundary."""
+        if isinstance(e, ast.Name):
+            return dyn.get(e.id)
+        if isinstance(e, ast.Constant):
+            return None
+        if isinstance(e, ast.Attribute):
+            if e.attr in STATIC_ATTRS:
+                return None
+            return self._dyn_extent(e.value, dyn, fi)
+        if isinstance(e, ast.Call):
+            tgts = self.call_targets(e.func, fi, fi.module)
+            ext = self._ext_of(tgts)
+            leaf = ext.split(".")[-1] if ext else ""
+            if ext in ("builtins.int", "builtins.float"):
+                if any(self._is_device_expr(a, fi) for a in e.args):
+                    return e.lineno            # the materialization
+                for a in e.args:
+                    got = self._dyn_extent(a, dyn, fi)
+                    if got is not None:
+                        return got
+                return None
+            if leaf in BUCKET_FNS:
+                return None
+            if isinstance(e.func, ast.Attribute) and \
+                    e.func.attr in BUCKET_METHODS:
+                return None
+            if ext and ext.startswith("jax"):
+                return None
+            if any(k == "func" for k, _ in tgts):
+                return None
+            args = list(e.args) + [kw.value for kw in e.keywords]
+            for a in args:
+                got = self._dyn_extent(a, dyn, fi)
+                if got is not None:
+                    return got
+            return None
+        if isinstance(e, ast.Lambda):
+            return None
+        if isinstance(e, ast.AST):
+            for c in ast.iter_child_nodes(e):
+                if isinstance(c, ast.AST):
+                    got = self._dyn_extent(c, dyn, fi)
+                    if got is not None:
+                        return got
+        return None
+
+    def _recompile_fn(self, fi: FuncInfo) -> None:
+        mod = fi.module
+        # statement list of this function, nested defs excluded (they
+        # are their own FuncInfos)
+        stmts = []
+        stack = [] if isinstance(fi.node, ast.Lambda) else \
+            list(fi.node.body)
+        while stack:
+            s = stack.pop()
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                continue
+            stmts.append(s)
+            for attr in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(s, attr, None) or ())
+            for h in getattr(s, "handlers", ()):
+                stack.extend(h.body)
+            for c in getattr(s, "cases", ()):   # ast.Match arms
+                stack.extend(c.body)
+        stmts.sort(key=lambda s: s.lineno)
+
+        top = set() if isinstance(fi.node, ast.Lambda) \
+            else set(fi.node.body)
+        dyn: Dict[str, int] = {}
+        for _ in range(3):   # small fixpoint for chained assignments
+            changed = False
+            for s in stmts:
+                if not isinstance(s, (ast.Assign, ast.AnnAssign,
+                                      ast.AugAssign)):
+                    continue
+                v = getattr(s, "value", None)
+                if v is None:
+                    continue
+                origin = self._dyn_extent(v, dyn, fi)
+                if origin is None:
+                    # A plain rebind to a clean value SANITIZES the
+                    # name (`cap = next_pow2(cap)` — the remedy the
+                    # finding message itself recommends).  Only at the
+                    # function's top level, where line order IS
+                    # execution order — a clean rebind inside one
+                    # branch must not mask taint from a sibling arm.
+                    # AugAssign keeps taint: `cap += 1` derives from
+                    # the old value.
+                    if s in top and isinstance(s, (ast.Assign,
+                                                   ast.AnnAssign)):
+                        targets = s.targets if isinstance(s, ast.Assign) \
+                            else [s.target]
+                        for t in targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    dyn.pop(n.id, None)
+                    continue
+                targets = s.targets if isinstance(s, ast.Assign) \
+                    else [s.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in dyn:
+                            dyn[n.id] = origin
+                            changed = True
+            if not changed:
+                break
+
+        for call in self._iter_calls(fi):
+            ext = self._ext_of(self.call_targets(call.func, fi, mod))
+            if not ext or not ext.startswith("jax"):
+                continue
+            leaf = ext.split(".")[-1]
+            extent_args = []
+            if leaf in SHAPE_CTORS:
+                extent_args += call.args[:1]
+            elif leaf == "arange" and len(call.args) == 1:
+                # multi-arg arange: start/stop offsets shift VALUES,
+                # the extent (stop - start) usually stays static
+                extent_args += call.args[:1]
+            extent_args += [kw.value for kw in call.keywords
+                            if kw.arg in ("shape", "size")]
+            for a in extent_args:
+                origin = self._dyn_extent(a, dyn, fi)
+                if origin is not None:
+                    self.report(
+                        mod, call.lineno, "recompile-risk",
+                        f"{leaf}() in {fi.qual} sized by a host int of "
+                        f"a device value (materialized at line "
+                        f"{origin}) — each distinct extent bakes a new "
+                        f"shape and recompiles every downstream jit; "
+                        f"use a static or pow2-bucketed capacity "
+                        f"(next_pow2), or waive a build-time one-shot")
+                    break
+
+    # -- style / cite ------------------------------------------------------
+    def run_style(self, mods=None) -> None:
+        for mod in (mods if mods is not None else self.modules.values()):
             text = "\n".join(mod.lines)
             if text and not text.endswith("\n") and mod.lines[-1] != "":
                 self.report(mod, len(mod.lines), "style",
@@ -1310,8 +1535,8 @@ class Analyzer:
                     self.report(mod, node.lineno, "style",
                                 "wildcard import")
 
-    def run_cite(self) -> None:
-        for mod in self.modules.values():
+    def run_cite(self, mods=None) -> None:
+        for mod in (mods if mods is not None else self.modules.values()):
             if not mod.rel.startswith("raft_tpu/") or \
                     mod.rel.endswith("__init__.py"):
                 continue
@@ -1323,26 +1548,44 @@ class Analyzer:
                             "('Ref:'), the parity-evidence convention")
 
     # -- driver ------------------------------------------------------------
-    def run(self, checks: Sequence[str]) -> List[Finding]:
-        self.findings.extend(self.parse_errors)
-        need_graph = {"host-sync", "axis-name"} & set(checks)
+    def run(self, checks: Sequence[str],
+            restrict: Optional[Set[str]] = None) -> List[Finding]:
+        """Run ``checks``; with ``restrict`` (a set of rel paths) the
+        LOCAL checks only visit those modules — graph checks always see
+        the whole tree (an interprocedural finding may live far from
+        the module that causes it).  Idempotent: each call starts from
+        empty findings, so the cache driver can run the local and graph
+        tiers as two separate calls."""
+        self.findings = []
+        self.waived = []
+        self._seen = set()
+        self._seen_waived = set()
+        self.findings.extend(
+            f for f in self.parse_errors
+            if restrict is None or f.rel in restrict)
+        mods = [m for m in self.modules.values()
+                if restrict is None or m.rel in restrict]
+        need_graph = set(GRAPH_CHECKS) & set(checks)
         if need_graph:
             self.build_graph()
         if "style" in checks:
-            self.run_style()
+            self.run_style(mods)
         if "cite" in checks:
-            self.run_cite()
+            self.run_cite(mods)
         if "host-sync" in checks:
             self.run_host_sync()
             self.run_round_trip()
         if "axis-name" in checks:
             self.run_axis_name()
         if "epoch-bump" in checks:
-            self.run_epoch()
+            self.run_epoch(mods)
         if "lock-discipline" in checks:
-            self.run_lock()
+            self.run_lock(mods)
         if "sentinel" in checks:
-            self.run_sentinel()
+            self.run_sentinel(mods)
+        if "recompile-risk" in checks:
+            self.run_recompile_risk()
+        self.waived.sort(key=lambda f: (f.rel, f.line, f.check, f.msg))
         return sorted(self.findings,
                       key=lambda f: (f.rel, f.line, f.check, f.msg))
 
@@ -1374,6 +1617,53 @@ def analyze_repo(root: Path = ROOT,
     return analyze_sources(repo_files(root), checks)
 
 
+def cache_module():
+    """Load ci/analyze_cache.py by path (ci/ is not a package; this
+    module itself is loaded standalone by tests and by `python
+    ci/analyze.py`, so a plain import has no anchor)."""
+    import importlib.util
+
+    name = "graft_analyze_cache"
+    if name in sys.modules:
+        return sys.modules[name]
+    path = Path(__file__).resolve().parent / "analyze_cache.py"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def analyze_repo_cached(root: Path = ROOT,
+                        checks: Optional[Sequence[str]] = None,
+                        cache_dir: Optional[Path] = None,
+                        use_cache: bool = True):
+    """Cached analyze over a repo tree.
+
+    Returns ``(findings, waived, stats)`` — ``stats`` is an
+    ``analyze_cache.CacheStats`` (None when ``use_cache=False``).  The
+    cache is PURE memoization: findings are identical to an uncached
+    run (check selection is applied when assembling results; cache
+    entries always hold the full per-tier check set, so a partial
+    ``--check`` run can never poison a later full run).
+    """
+    cs = tuple(checks) if checks else CHECKS
+    files = repo_files(root)
+    if not use_cache:
+        an = Analyzer(files)
+        findings = an.run(cs)
+        return findings, list(an.waived), None
+    import types
+
+    ac = cache_module()
+    cdir = Path(cache_dir) if cache_dir is not None \
+        else Path(root) / ".analyze_cache"
+    api = types.SimpleNamespace(Analyzer=Analyzer, Finding=Finding,
+                                LOCAL_CHECKS=LOCAL_CHECKS,
+                                GRAPH_CHECKS=GRAPH_CHECKS)
+    return ac.run_cached(api, files, cs, cdir)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         description="graft-analyze: TPU tracing-safety & concurrency "
@@ -1382,17 +1672,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="run only this check (repeatable; default all)")
     ap.add_argument("--list-checks", action="store_true")
     ap.add_argument("--root", default=str(ROOT))
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the incremental result cache")
+    ap.add_argument("--cache-dir", default=None,
+                    help="cache directory (default <root>/.analyze_cache)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print a cache/waiver summary line")
+    ap.add_argument("--show-waived", action="store_true",
+                    help="print waived findings (never affect exit code)")
     args = ap.parse_args(argv)
     if args.list_checks:
         for c in CHECKS:
             print(c)
         return 0
     checks = tuple(args.check) if args.check else CHECKS
-    findings = analyze_repo(Path(args.root), checks)
+    findings, waived, stats = analyze_repo_cached(
+        Path(args.root), checks,
+        cache_dir=Path(args.cache_dir) if args.cache_dir else None,
+        use_cache=not args.no_cache)
     for f in findings:
         print(f.render())
+    if args.show_waived:
+        for f in waived:
+            print(f"{f.rel}:{f.line}: [{f.check}] waived"
+                  + (f" — {f.msg}" if f.msg else ""))
     print(f"graft-analyze: {len(findings)} finding(s) "
           f"[checks: {', '.join(checks)}]")
+    if args.stats:
+        if stats is None:
+            print(f"graft-analyze-cache: disabled; "
+                  f"{len(waived)} waived")
+        else:
+            graph = "skipped" if stats.graph_hit is None \
+                else ("hit" if stats.graph_hit else "miss")
+            print(f"graft-analyze-cache: modules {stats.mod_hits} hit / "
+                  f"{stats.mod_misses} miss, graph {graph}, "
+                  f"{stats.pruned} pruned; {len(waived)} waived")
     return 1 if findings else 0
 
 

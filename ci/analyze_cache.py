@@ -1,0 +1,290 @@
+"""graft-analyze incremental result cache (ci/analyze.py's second tier).
+
+Two-tier memoization of analyzer results under ``.analyze_cache/``,
+mirroring the check taxonomy (the caching analog of the reference's
+ccache-wrapped build gate, retargeted at analysis instead of
+compilation):
+
+``mod-<key>.json``
+    One module's LOCAL-check results (style / cite / epoch-bump /
+    lock-discipline / sentinel), shaped ``{check: {"f": [[line, msg]],
+    "w": [[line, msg]]}}`` plus a ``"_parse"`` pseudo-tier holding
+    syntax-error findings (reported unconditionally, exactly like the
+    uncached ``Analyzer.run`` — a ``--check host-sync`` run must still
+    fail on an unparseable file).  ``key = sha256(fingerprint + rel +
+    source)[:16]`` — a module's local findings depend on nothing but
+    its own text, so editing one file invalidates exactly one entry.
+
+``graph-<key>.json``
+    The whole-program GRAPH-check results (host-sync / axis-name /
+    recompile-risk), shaped ``{"f": [[rel, line, check, msg]],
+    "w": [[rel, line, check, msg]]}``.  ``key = sha256(fingerprint +
+    every module's (rel, mod_key))`` — an interprocedural finding may
+    move when ANY module changes (a new jit entry point upstream makes
+    a helper hot), so this tier is deliberately all-or-nothing.
+
+Both keys fold in a FINGERPRINT of the analyzer's own sources
+(analyze.py + this file) plus a format version, so editing the analyzer
+orphans every entry rather than replaying results from older semantics.
+
+Invariants (tests/test_analyze_cache.py):
+
+* pure memoization — warm findings render bit-identical to cold;
+* entries always hold the FULL per-tier check set (a ``--check
+  host-sync`` run still computes and stores all graph checks, and
+  filters at assembly), so partial runs can never poison full runs;
+* corrupt / truncated entries read as misses and are rewritten — that
+  includes well-formed JSON with the wrong row shape, not just broken
+  bytes (a malformed entry must never traceback the gate);
+* writes are atomic (tmp + rename) and best-effort — an unwritable
+  cache degrades to uncached analysis, never to an error;
+* the directory self-prunes to ~2 entries per module, oldest-mtime
+  first, so abandoned fingerprints age out.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FORMAT_VERSION = "graft-analyze-cache-v2"
+
+# Pseudo-tier inside a mod entry for syntax-error findings: they are
+# reported regardless of the check selection (matching the uncached
+# Analyzer.run), so they cannot live under the filterable "style" key.
+PARSE_TIER = "_parse"
+
+
+@dataclass
+class CacheStats:
+    mod_hits: int = 0
+    mod_misses: int = 0
+    graph_hit: Optional[bool] = None   # None = no graph check requested
+    pruned: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Keys
+
+
+def fingerprint() -> str:
+    """Hash of the analyzer's own sources + cache format version: any
+    edit to the semantics orphans every cached result."""
+    h = hashlib.sha256(FORMAT_VERSION.encode())
+    here = Path(__file__).resolve().parent
+    for name in ("analyze.py", "analyze_cache.py"):
+        p = here / name
+        if p.exists():
+            h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def module_key(fp: str, rel: str, source: str) -> str:
+    h = hashlib.sha256()
+    h.update(fp.encode())
+    h.update(rel.encode())
+    h.update(b"\0")
+    h.update(source.encode())
+    return h.hexdigest()[:16]
+
+
+def graph_key(fp: str, mod_keys: Dict[str, str]) -> str:
+    h = hashlib.sha256()
+    h.update(fp.encode())
+    for rel in sorted(mod_keys):
+        h.update(f"{rel}:{mod_keys[rel]}\n".encode())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Entry IO (best-effort: any OSError / bad JSON is a miss, not an error)
+
+
+def _load(path: Path):
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _store(path: Path, obj) -> None:
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_name(f".{path.name}.{os.getpid()}.tmp")
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(obj, fh)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def _rows_ok(v, key: str, tail_types: Tuple[type, ...]) -> bool:
+    """``v[key]`` is a list of rows shaped ``[int, *tail_types]``.
+    Well-formed JSON with the wrong row shape must read as a miss, not
+    traceback at assembly time."""
+    if not isinstance(v, dict):
+        return False
+    rows = v.get(key)
+    return isinstance(rows, list) and all(
+        isinstance(r, list) and len(r) == 1 + len(tail_types)
+        and isinstance(r[0], int)
+        and all(isinstance(x, t) for x, t in zip(r[1:], tail_types))
+        for r in rows)
+
+
+def load_module_entry(cache_dir: Path, key: str,
+                      local_checks: Sequence[str]):
+    """The entry, or None on miss/corruption/stale check set."""
+    entry = _load(cache_dir / f"mod-{key}.json")
+    if not isinstance(entry, dict) or \
+            set(entry) != set(local_checks) | {PARSE_TIER} or \
+            not all(_rows_ok(v, "f", (str,)) and _rows_ok(v, "w", (str,))
+                    for v in entry.values()):
+        return None
+    return entry
+
+
+def store_module_entry(cache_dir: Path, key: str, entry) -> None:
+    _store(cache_dir / f"mod-{key}.json", entry)
+
+
+def load_graph_entry(cache_dir: Path, key: str):
+    entry = _load(cache_dir / f"graph-{key}.json")
+    if not isinstance(entry, dict) or not all(
+            isinstance(entry.get(k), list) and all(
+                isinstance(r, list) and len(r) == 4
+                and isinstance(r[0], str) and isinstance(r[1], int)
+                and isinstance(r[2], str) and isinstance(r[3], str)
+                for r in entry[k])
+            for k in ("f", "w")):
+        return None
+    return entry
+
+
+def store_graph_entry(cache_dir: Path, key: str, entry) -> None:
+    _store(cache_dir / f"graph-{key}.json", entry)
+
+
+def prune(cache_dir: Path, keep: int) -> int:
+    """Drop oldest-mtime entries beyond ``keep``; returns the count."""
+    try:
+        entries = [p for p in cache_dir.iterdir()
+                   if p.name.startswith(("mod-", "graph-"))]
+    except OSError:
+        return 0
+    if len(entries) <= keep:
+        return 0
+    entries.sort(key=lambda p: (p.stat().st_mtime, p.name))
+    n = 0
+    for p in entries[: len(entries) - keep]:
+        try:
+            p.unlink()
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Cached run driver
+
+
+def run_cached(ga, files: Dict[str, str], checks: Sequence[str],
+               cache_dir: Path) -> Tuple[list, list, CacheStats]:
+    """Cached analog of ``Analyzer(files).run(checks)`` over a loaded
+    tree.  ``ga`` is the analyze module object (passed in because both
+    modules are loaded standalone by path — there is no package anchor
+    for a circular import).  Returns ``(findings, waived, stats)``
+    filtered down to ``checks``; entries are computed and stored for
+    the full per-tier check sets regardless of the filter.
+    """
+    stats = CacheStats()
+    fp = fingerprint()
+    mod_keys = {rel: module_key(fp, rel, src)
+                for rel, src in files.items()}
+
+    local_entries: Dict[str, dict] = {}
+    misses: List[str] = []
+    want_local = set(checks) & set(ga.LOCAL_CHECKS)
+    want_graph = set(checks) & set(ga.GRAPH_CHECKS)
+    for rel in sorted(files):
+        entry = load_module_entry(cache_dir, mod_keys[rel],
+                                  ga.LOCAL_CHECKS)
+        if entry is None:
+            misses.append(rel)
+            stats.mod_misses += 1
+        else:
+            local_entries[rel] = entry
+            stats.mod_hits += 1
+
+    gkey = graph_key(fp, mod_keys)
+    if want_graph:
+        graph_entry = load_graph_entry(cache_dir, gkey)
+        stats.graph_hit = graph_entry is not None
+    else:
+        graph_entry = {"f": [], "w": []}
+
+    an = None
+    if misses or graph_entry is None:
+        an = ga.Analyzer(files)
+
+    if misses:
+        found = an.run(ga.LOCAL_CHECKS, restrict=set(misses))
+        waived = list(an.waived)
+        # Syntax errors surface as check="style" findings but must be
+        # reported regardless of the check selection (the uncached run
+        # does) — store them under the PARSE_TIER pseudo-key instead.
+        parse_rows = {}
+        for f in an.parse_errors:
+            parse_rows.setdefault(f.rel, []).append([f.line, f.msg])
+        for rel in misses:
+            entry = {c: {"f": [], "w": []} for c in ga.LOCAL_CHECKS}
+            entry[PARSE_TIER] = {"f": parse_rows.get(rel, []), "w": []}
+            pset = {tuple(r) for r in entry[PARSE_TIER]["f"]}
+            for f in found:
+                if f.rel == rel and f.check in entry and \
+                        (f.line, f.msg) not in pset:
+                    entry[f.check]["f"].append([f.line, f.msg])
+            for f in waived:
+                if f.rel == rel and f.check in entry:
+                    entry[f.check]["w"].append([f.line, f.msg])
+            local_entries[rel] = entry
+            store_module_entry(cache_dir, mod_keys[rel], entry)
+
+    if graph_entry is None:
+        found = an.run(ga.GRAPH_CHECKS)
+        graph_entry = {
+            "f": [[f.rel, f.line, f.check, f.msg] for f in found
+                  if f.check in ga.GRAPH_CHECKS],
+            "w": [[f.rel, f.line, f.check, f.msg] for f in an.waived
+                  if f.check in ga.GRAPH_CHECKS],
+        }
+        store_graph_entry(cache_dir, gkey, graph_entry)
+
+    stats.pruned = prune(cache_dir, keep=2 * max(len(files), 8) + 64)
+
+    findings: List = []
+    waived_out: List = []
+    for rel in sorted(local_entries):
+        entry = local_entries[rel]
+        for line, msg in entry[PARSE_TIER]["f"]:   # unconditional
+            findings.append(ga.Finding(rel, line, "style", msg))
+        for check in want_local:
+            for line, msg in entry[check]["f"]:
+                findings.append(ga.Finding(rel, line, check, msg))
+            for line, msg in entry[check]["w"]:
+                waived_out.append(ga.Finding(rel, line, check, msg))
+    for rel, line, check, msg in graph_entry["f"]:
+        if check in want_graph:
+            findings.append(ga.Finding(rel, line, check, msg))
+    for rel, line, check, msg in graph_entry["w"]:
+        if check in want_graph:
+            waived_out.append(ga.Finding(rel, line, check, msg))
+
+    key = lambda f: (f.rel, f.line, f.check, f.msg)
+    return sorted(findings, key=key), sorted(waived_out, key=key), stats

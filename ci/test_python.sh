@@ -4,9 +4,14 @@
 #
 # Four lanes:
 #   * analyze: graft-analyze (ci/analyze.py) — style/citation checks
-#     plus the five TPU semantic checks (host-sync, axis-name,
-#     epoch-bump, lock-discipline, sentinel); blocking, must be clean
-#     (waivers live inline next to the code — docs/static_analysis.md);
+#     plus the six TPU semantic checks (host-sync, axis-name,
+#     epoch-bump, lock-discipline, sentinel, recompile-risk);
+#     blocking, must be clean (waivers live inline next to the code —
+#     docs/static_analysis.md).  Incremental: results are memoized
+#     under .analyze_cache keyed on module content + the analyzer's
+#     own sources, so repeat runs replay in ~0.3s (--stats prints the
+#     hit/miss accounting; pure memoization, proven bit-identical by
+#     tests/test_analyze_cache.py);
 #   * tier-1: everything except the chaos marker (the fast correctness
 #     gate — fault-injection stays out of its budget);
 #   * chaos:  the deterministic fault-injection lane
@@ -23,7 +28,7 @@
 #     catches shape-warmup ordering the full run can mask).
 set -euo pipefail
 cd "$(dirname "$0")/.."
-python ci/analyze.py
+python ci/analyze.py --stats
 python -m pytest tests/ -x -q -m "not chaos"
 python -m pytest tests/ -x -q -m "chaos"
 python -m pytest tests/ -x -q -m "sanitized"

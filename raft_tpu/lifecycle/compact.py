@@ -120,9 +120,15 @@ def _repack(flat_rows, labels, flat_ids, n_lists: int, min_cap: int):
         [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
     pos = (jnp.arange(labels.shape[0], dtype=jnp.int32)
            - offsets[jnp.minimum(sl, n_lists - 1)].astype(jnp.int32))
+    # Exact-fit capacity is a compact-pass one-shot: shrink_capacity
+    # opts OUT of the keep-capacity default precisely to re-fit
+    # storage, and the successor publishes once per pass (not per
+    # query), so the fresh shape class is paid once by design.
+    # analyze: recompile-risk-ok (shrink_capacity pass, once per compaction)
     store = jnp.zeros((n_lists, cap) + flat_rows.shape[1:],
                       flat_rows.dtype)
-    ids = jnp.full((n_lists, cap), PAD_ID, flat_ids.dtype)
+    ids = jnp.full((n_lists, cap), PAD_ID,  # analyze: recompile-risk-ok (see above)
+                   flat_ids.dtype)
     store = store.at[sl, pos].set(flat_rows[order], mode="drop")
     ids = ids.at[sl, pos].set(flat_ids[order], mode="drop")
     return store, ids, counts.astype(jnp.int32), cap

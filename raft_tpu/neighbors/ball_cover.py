@@ -139,6 +139,10 @@ def build_index(
         [jnp.zeros((1,), sizes.dtype), jnp.cumsum(sizes)])[:-1]
     pos = jnp.arange(m, dtype=jnp.int32) - starts[sorted_assign].astype(
         jnp.int32)
+    # Build-time one-shot: the ragged→rect group packing inherently
+    # sizes to the largest landmark group; queries against the built
+    # index reuse its fixed shapes, so the class is paid once per build.
+    # analyze: recompile-risk-ok (build-time pack, once per index)
     grp_idx_j = (jnp.full((L, cap), -1, jnp.int32)
                  .at[sorted_assign, pos].set(order.astype(jnp.int32)))
     radii = jax.ops.segment_max(nn_dist, assign, num_segments=L)

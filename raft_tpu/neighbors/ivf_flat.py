@@ -218,8 +218,14 @@ def _pack_lists(
     offsets = jnp.concatenate([jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)])[:-1]
     pos = jnp.arange(n, dtype=jnp.int32) - offsets[sorted_labels].astype(jnp.int32)
 
+    # Build-time one-shot: the bulk-fill caller passes a next_pow2
+    # min_cap so steady-state capacity classes stay bucketed; only
+    # conservative_memory_allocation opts into exact-fit shapes (and
+    # pays a rebuild-grade compile when capacity moves, documented).
+    # analyze: recompile-risk-ok (build-time pack; bulk path is pow2-bucketed)
     data = jnp.zeros((n_lists, cap, d), X.dtype)
-    idx = jnp.full((n_lists, cap), -1, ids.dtype)
+    idx = jnp.full((n_lists, cap), -1,  # analyze: recompile-risk-ok (see above)
+                   ids.dtype)
     data = data.at[sorted_labels, pos].set(X[order])
     idx = idx.at[sorted_labels, pos].set(ids[order])
     return data, idx, counts.astype(jnp.int32)

@@ -549,6 +549,120 @@ def test_sentinel_waiver():
 
 
 # ---------------------------------------------------------------------------
+# recompile-risk: data-dependent array extents in eager code
+
+
+RR = '''
+"""Doc."""
+import jax
+import jax.numpy as jnp
+
+
+def build(counts, rows, n_lists):
+{body}
+'''
+
+
+@pytest.mark.parametrize("body,should_flag", [
+    # THE pattern: device max pulled to a host int, fed to an extent —
+    # every distinct value bakes a new shape downstream
+    ("    cap = int(jnp.max(counts))\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", True),
+    # propagation through host arithmetic
+    ("    cap = int(jnp.max(counts))\n"
+     "    cap2 = max(cap + 1, 4)\n"
+     "    return jnp.zeros((n_lists, cap2), jnp.float32)", True),
+    # inline materialization inside the shape argument
+    ("    return jnp.zeros((n_lists, int(jnp.max(counts))), jnp.float32)",
+     True),
+    # single-arg arange: the argument IS the extent
+    ("    nb = int(jnp.sum(counts))\n"
+     "    return jnp.arange(nb)", True),
+    # size= kwarg (jnp.nonzero-style) is an extent
+    ("    nb = int(jnp.sum(counts))\n"
+     "    return jnp.nonzero(counts, size=nb, fill_value=0)", True),
+    # static extent from a parameter: clean
+    ("    return jnp.zeros((n_lists, 8), jnp.float32)", False),
+    # .shape-derived extent is static even when a dyn scalar exists
+    ("    cap = int(jnp.max(counts))\n"
+     "    out = jnp.zeros(rows.shape, jnp.float32)\n"
+     "    return out, cap", False),
+    # pow2 bucketing via .bit_length(): log-many classes, by design
+    ("    nb = 1 << (int(jnp.max(counts)) - 1).bit_length()\n"
+     "    return jnp.zeros((n_lists, nb), jnp.float32)", False),
+    # pow2 bucketing via next_pow2(): same sanitizer, named form
+    ("    cap = next_pow2(int(jnp.max(counts)))\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", False),
+    # multi-arg arange: start/stop shift values, not the extent
+    ("    base = int(jnp.max(counts))\n"
+     "    return jnp.arange(base, base + 16)", False),
+    # host-only source (no device value): not this check's business
+    ("    cap = int(len(rows))\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", False),
+    # sanitizing REBIND — the remedy the finding message recommends —
+    # must clear the taint, not just the inline form
+    ("    cap = int(jnp.max(counts))\n"
+     "    cap = next_pow2(cap)\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", False),
+    # rebind to a clean value likewise kills the stale taint
+    ("    cap = int(jnp.max(counts))\n"
+     "    cap = 8\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", False),
+    # AugAssign derives from the OLD value: taint survives `cap += 1`
+    ("    cap = int(jnp.max(counts))\n"
+     "    cap += 1\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", True),
+    # assignments inside match arms feed the taint map too
+    ("    match n_lists:\n"
+     "        case 0:\n"
+     "            cap = int(jnp.max(counts))\n"
+     "        case _:\n"
+     "            cap = 4\n"
+     "    return jnp.zeros((n_lists, cap), jnp.float32)", True),
+])
+def test_recompile_risk_grid(body, should_flag):
+    src = RR.format(body=body)
+    if "next_pow2" in body:
+        src = src.replace(
+            "import jax.numpy as jnp",
+            "import jax.numpy as jnp\n"
+            "from raft_tpu.util.pow2 import next_pow2")
+    found = run(src, ["recompile-risk"])
+    assert bool(found) == should_flag, [f.render() for f in found]
+
+
+def test_recompile_risk_waiver_and_recording():
+    body = ("    cap = int(jnp.max(counts))\n"
+            "    # analyze: recompile-risk-ok (build-time one-shot)\n"
+            "    return jnp.zeros((n_lists, cap), jnp.float32)")
+    files = {"raft_tpu/fx/mod.py":
+             textwrap.dedent(RR.format(body=body))}
+    an = ga.Analyzer(files)
+    assert an.run(("recompile-risk",)) == []
+    # the waived finding is RECORDED (cache / --show-waived surface),
+    # it just never affects the exit code
+    assert [(f.rel, f.line, f.check) for f in an.waived] == \
+        [("raft_tpu/fx/mod.py", 10, "recompile-risk")]
+
+
+def test_recompile_risk_skips_traced_functions():
+    """Inside jit the int() is host-sync's finding — recompile-risk
+    stays silent so one defect maps to one check."""
+    src = '''
+        """Doc."""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            n = int(jnp.max(x))
+            return jnp.zeros((n,), jnp.float32)
+        '''
+    assert run(src, ["recompile-risk"]) == []
+    assert run(src, ["host-sync"]) != []
+
+
+# ---------------------------------------------------------------------------
 # the shared sentinel definitions themselves
 
 

@@ -1,0 +1,334 @@
+"""graft-analyze incremental cache (ci/analyze_cache.py) acceptance.
+
+The cache must be PURE memoization: a warm run returns findings
+bit-identical to a cold run, an edit to one module re-analyzes exactly
+that module plus the graph tier, an edit to the analyzer itself
+(fingerprint) orphans everything, corruption reads as a miss, and the
+directory self-prunes.  The graph tier is all-or-nothing by design —
+a cross-module test proves why (an interprocedural finding lands in a
+module whose OWN entry was a cache hit).
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import sys
+import textwrap
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load(name, relpath):
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, ROOT / relpath)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+ga = _load("graft_analyze", "ci/analyze.py")
+ac = ga.cache_module()
+
+
+def write_tree(root, files):
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+
+CLEAN_MOD = '''
+    """Doc. Ref: x."""
+    X = 1
+    '''
+
+# wildcard import: one deterministic style finding at line 3
+DIRTY_MOD = '''
+    """Doc. Ref: x."""
+
+    from os.path import *
+    '''
+
+WAIVED_MOD = '''
+    """Doc. Ref: x."""
+    import jax.numpy as jnp
+
+    def pad(x):
+        return jnp.full((4,), -1, jnp.int32)  # analyze: sentinel-ok
+    '''
+
+HELPER_MOD = '''
+    """Doc. Ref: x."""
+    import numpy as np
+
+    def leaky(v):
+        return np.asarray(v)
+    '''
+
+HOT_MOD = '''
+    """Doc. Ref: x."""
+    import functools
+    import jax
+    from raft_tpu.fx.helper import leaky
+
+    @functools.partial(jax.jit, static_argnames=())
+    def entry(x):
+        return leaky(x)
+    '''
+
+
+@pytest.fixture
+def tree(tmp_path):
+    write_tree(tmp_path, {
+        "raft_tpu/fx/a.py": DIRTY_MOD,
+        "raft_tpu/fx/b.py": CLEAN_MOD,
+        "raft_tpu/comms/w.py": WAIVED_MOD,
+        "raft_tpu/fx/helper.py": HELPER_MOD,
+    })
+    return tmp_path
+
+
+def run_cached(root, checks=None, cache_dir=None, use_cache=True):
+    return ga.analyze_repo_cached(
+        root, checks,
+        cache_dir=cache_dir if cache_dir is not None
+        else root / ".analyze_cache",
+        use_cache=use_cache)
+
+
+def renders(findings):
+    return [f.render() for f in findings]
+
+
+def wkeys(waived):
+    return sorted((f.rel, f.line, f.check) for f in waived)
+
+
+# ---------------------------------------------------------------------------
+# Parity and hit/miss accounting
+
+
+def test_cold_warm_parity_and_accounting(tree):
+    cold_f, cold_w, cold_s = run_cached(tree)
+    assert cold_s.mod_hits == 0 and cold_s.mod_misses == 4
+    assert not cold_s.graph_hit
+    assert [f.check for f in cold_f] == ["style"]     # the trailing ws
+    assert wkeys(cold_w) == [("raft_tpu/comms/w.py", 6, "sentinel")]
+
+    warm_f, warm_w, warm_s = run_cached(tree)
+    assert warm_s.mod_hits == 4 and warm_s.mod_misses == 0
+    assert warm_s.graph_hit
+    assert renders(warm_f) == renders(cold_f)         # bit-identical
+    assert wkeys(warm_w) == wkeys(cold_w)
+
+
+def test_uncached_matches_cached(tree):
+    plain_f, _, none_stats = run_cached(tree, use_cache=False)
+    assert none_stats is None
+    assert not (tree / ".analyze_cache").exists()   # nothing written
+    cached_f, _, _ = run_cached(tree)
+    assert renders(plain_f) == renders(cached_f)
+
+
+def test_single_module_edit_invalidates_one_entry(tree):
+    run_cached(tree)
+    # fix the dirty module: exactly one local entry recomputes, the
+    # graph tier (keyed on every module) recomputes too
+    (tree / "raft_tpu/fx/a.py").write_text(textwrap.dedent(CLEAN_MOD))
+    f, _, s = run_cached(tree)
+    assert s.mod_misses == 1 and s.mod_hits == 3
+    assert not s.graph_hit
+    assert f == []
+    # and the run after THAT is a full hit again
+    _, _, s2 = run_cached(tree)
+    assert s2.mod_misses == 0 and s2.graph_hit
+
+
+def test_graph_tier_is_all_or_nothing_for_a_reason(tree):
+    """A new jit entry point in one module makes a helper in ANOTHER
+    module hot: the helper's finding must appear although the helper's
+    own mod entry was a cache hit — this is exactly why graph-check
+    results cannot be cached per module."""
+    f, _, _ = run_cached(tree, checks=("host-sync",))
+    assert f == []                       # helper alone is not hot
+    write_tree(tree, {"raft_tpu/fx/hot.py": HOT_MOD})
+    f, _, s = run_cached(tree, checks=("host-sync",))
+    assert s.mod_hits == 4 and s.mod_misses == 1      # helper entry HIT
+    assert [x.rel for x in f] == ["raft_tpu/fx/helper.py"]
+
+
+# ---------------------------------------------------------------------------
+# Invalidation / robustness
+
+
+def test_fingerprint_invalidation(tree, monkeypatch):
+    run_cached(tree)
+    monkeypatch.setattr(ac, "FORMAT_VERSION", "test-salt")
+    _, _, s = run_cached(tree)
+    assert s.mod_misses == 4 and not s.graph_hit      # all orphaned
+
+
+def test_corrupt_entry_is_a_miss_and_heals(tree):
+    cold_f, _, _ = run_cached(tree)
+    cdir = tree / ".analyze_cache"
+    victim = sorted(cdir.glob("mod-*.json"))[0]
+    victim.write_text("{ not json")
+    f, _, s = run_cached(tree)
+    assert s.mod_misses == 1
+    assert renders(f) == renders(cold_f)
+    assert json.loads(victim.read_text())             # rewritten valid
+
+
+def test_malformed_entry_shape_is_a_miss(tree):
+    """Well-formed JSON with the wrong row arity/types must read as a
+    miss and heal — never traceback the gate at assembly time."""
+    cold_f, _, _ = run_cached(tree)
+    cdir = tree / ".analyze_cache"
+    sorted(cdir.glob("graph-*.json"))[0].write_text(
+        '{"f": [["a", 1]], "w": []}')          # arity-2 row, expects 4
+    sorted(cdir.glob("mod-*.json"))[0].write_text(
+        '{"style": {"f": [[1]], "w": []}}')    # stale check set + arity
+    f, _, s = run_cached(tree)
+    assert renders(f) == renders(cold_f)
+    assert s.mod_misses == 1 and not s.graph_hit
+    _, _, s2 = run_cached(tree)                # healed
+    assert s2.mod_misses == 0 and s2.graph_hit
+
+
+SYNTAX_ERR_MOD = '''
+    """Doc. Ref: x."""
+    def broken(:
+    '''
+
+
+def test_syntax_error_survives_check_subset(tree):
+    """Parse errors surface as check="style" findings but must be
+    reported regardless of the --check selection, cached or not — a
+    subsetted gate run must still fail on an unparseable file."""
+    write_tree(tree, {"raft_tpu/fx/bad.py": SYNTAX_ERR_MOD})
+    plain_f, _, _ = run_cached(tree, checks=("host-sync",),
+                               use_cache=False)
+    cold_f, _, _ = run_cached(tree, checks=("host-sync",))
+    warm_f, _, s = run_cached(tree, checks=("host-sync",))
+    assert renders(plain_f) == renders(cold_f) == renders(warm_f)
+    assert any("syntax error" in f.msg for f in warm_f)
+    assert s.mod_misses == 0                   # from the warm cache
+
+
+def test_waived_messages_survive_the_cache(tree):
+    """--show-waived exists to audit the exemption surface: the
+    diagnostic text must be identical cached, warm, and uncached."""
+    _, plain_w, _ = run_cached(tree, use_cache=False)
+    _, cold_w, _ = run_cached(tree)
+    _, warm_w, _ = run_cached(tree)
+    quads = lambda ws: [(f.rel, f.line, f.check, f.msg) for f in ws]
+    assert quads(cold_w) == quads(plain_w)
+    assert quads(warm_w) == quads(plain_w)
+    assert all(f.msg for f in warm_w)
+
+
+def test_partial_check_run_cannot_poison_full_run(tree):
+    """Entries always hold the full per-tier check set: a --check
+    style cold run followed by a full warm run must still surface the
+    sentinel waiver and the graph results."""
+    f, w, _ = run_cached(tree, checks=("style",))
+    assert [x.check for x in f] == ["style"] and w == []
+    f, w, s = run_cached(tree)                        # full, warm local
+    assert s.mod_hits == 4
+    assert [x.check for x in f] == ["style"]
+    assert wkeys(w) == [("raft_tpu/comms/w.py", 6, "sentinel")]
+
+
+def test_check_filter_applies_on_warm_hits(tree):
+    run_cached(tree)
+    f, _, s = run_cached(tree, checks=("cite",))
+    assert s.mod_hits == 4 and f == []
+    f, _, _ = run_cached(tree, checks=("style",))
+    assert [x.check for x in f] == ["style"]
+
+
+def test_prune_keeps_newest(tree):
+    cdir = tree / ".analyze_cache"
+    cdir.mkdir()
+    for i in range(120):                  # junk with ancient mtimes
+        p = cdir / f"mod-junk{i:04d}.json"
+        p.write_text("{}")
+        os.utime(p, (1, 1))
+    _, _, s = run_cached(tree)
+    # keep bound: 2 * max(n_files, 8) + 64 = 80 for this 4-file tree
+    assert s.pruned == 120 + 5 - 80
+    assert len(list(cdir.glob("*.json"))) == 80
+    _, _, s2 = run_cached(tree)           # real entries survived
+    assert s2.mod_hits == 4 and s2.graph_hit
+
+
+def test_unwritable_cache_degrades_to_uncached(tree):
+    # a regular FILE as the parent: every mkdir/open/iterdir under it
+    # raises NotADirectoryError regardless of uid (chmod-based
+    # read-only fixtures are bypassed when the suite runs as root)
+    blocker = tree / "blocker"
+    blocker.write_text("")
+    f, _, s = run_cached(tree, cache_dir=blocker / "cache")
+    assert [x.check for x in f] == ["style"]
+    assert s.mod_misses == 4              # nothing stored, still correct
+    # and a second run is still correct (and still uncached)
+    f2, _, s2 = run_cached(tree, cache_dir=blocker / "cache")
+    assert renders(f2) == renders(f) and s2.mod_misses == 4
+
+
+# ---------------------------------------------------------------------------
+# CLI integration
+
+
+def test_main_exit_codes_and_stats_with_cache(tree, capsys):
+    args = ["--root", str(tree), "--stats"]
+    assert ga.main(args) == 1
+    out = capsys.readouterr().out
+    assert "graft-analyze-cache: modules 0 hit / 4 miss" in out
+    assert ga.main(args) == 1             # warm, same verdict
+    out = capsys.readouterr().out
+    assert "graft-analyze-cache: modules 4 hit / 0 miss" in out
+    (tree / "raft_tpu/fx/a.py").write_text(textwrap.dedent(CLEAN_MOD))
+    assert ga.main(args) == 0
+
+
+def test_main_stats_graph_skipped_for_local_only_run(tree, capsys):
+    ga.main(["--root", str(tree), "--check", "style", "--stats"])
+    assert "graph skipped" in capsys.readouterr().out
+
+
+def test_main_show_waived(tree, capsys):
+    ga.main(["--root", str(tree), "--show-waived"])
+    out = capsys.readouterr().out
+    assert "raft_tpu/comms/w.py:6: [sentinel] waived" in out
+
+
+def test_main_no_cache(tree, capsys):
+    assert ga.main(["--root", str(tree), "--no-cache", "--stats"]) == 1
+    out = capsys.readouterr().out
+    assert "graft-analyze-cache: disabled" in out
+    assert not (tree / ".analyze_cache").exists()
+
+
+# ---------------------------------------------------------------------------
+# Bench family smoke (tier-1)
+
+
+def test_analyze_bench_smoke(capsys):
+    from bench.analyze import run
+
+    run(quick=True)
+    out = capsys.readouterr().out
+    metrics = {json.loads(l)["metric"] for l in out.splitlines() if l}
+    assert {"analyze_cold_s", "analyze_warm_s",
+            "analyze_warm_speedup"} <= metrics
+    for l in out.splitlines():
+        rec = json.loads(l)
+        if rec["metric"] == "analyze_warm_speedup":
+            assert rec["warm_full_hit"] is True
+            assert rec["findings"] == 0
