@@ -599,6 +599,16 @@ def _family_analyze():
     run(quick=False)
 
 
+def _family_obs():
+    """Observability-overhead metrics (ISSUE 11): tracer-on vs
+    tracer-off serving QPS delta, full-registry scrape cost, and
+    recall-probe overhead at 1% sampling.  Body lives in bench/obs.py
+    (shared with the tier-1 smoke test)."""
+    from bench.obs import run
+
+    run(quick=False)
+
+
 def _family_sharded():
     """Merge-engine metrics for the sharded search paths (ISSUE 1): QPS +
     estimated per-device exchange bytes per engine (allgather | ring |
@@ -709,6 +719,7 @@ def main():
     if "--no-1m" not in sys.argv:
         _run_family(_family_sharded, "bench_sharded_error")
         _run_family(_family_serve, "bench_serve_error")
+        _run_family(_family_obs, "bench_obs_error")
         _run_family(_family_lifecycle, "bench_lifecycle_error")
         _run_family(_family_1m, "bench_1m_error")
         _run_family(_family_sift1m_u8, "bench_sift1m_error")

@@ -1,0 +1,139 @@
+"""Observability-overhead bench family (ISSUE 11 CI satellite).
+
+The obs layer's contract is "zero-cost when disabled, cheap when on";
+this family measures it instead of asserting it:
+
+* ``obs_tracer_off_qps`` / ``obs_tracer_on_qps`` /
+  ``obs_tracer_overhead_pct`` — steady-state served QPS through the
+  ``BatchScheduler`` with the default ``NULL_TRACER`` vs a recording
+  :class:`~raft_tpu.obs.trace.Tracer` (which also pays the
+  ``block_until_ready`` device fence per batch).  Tracer-off must sit
+  within bench noise of the pre-obs baseline; tracer-on buys a complete
+  span tree per request for the reported delta.
+* ``obs_scrape_ms`` — one full ``MetricsRegistry.prometheus_text()``
+  scrape (collectors + exposition) over every island adapter, populated
+  with serving state — the cost a scraper imposes per poll.
+* ``obs_probe_overhead_pct`` — served QPS with a
+  :class:`~raft_tpu.obs.recall.RecallProbe` sampling at 1% (enqueue on
+  the hot path, exact scans drained off it) vs no probe.
+
+``quick=True`` is the tier-1 smoke shape (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def _stream(rng, n_requests, max_rows, dim, k):
+    return [(rng.normal(size=(int(rng.integers(1, max_rows + 1)),
+                              dim)).astype(np.float32), k)
+            for _ in range(n_requests)]
+
+
+def _drive_qps(sched, reqs):
+    t0 = time.perf_counter()
+    tickets = [sched.submit(q, k) for q, k in reqs]
+    sched.run_until_idle()
+    sec = time.perf_counter() - t0
+    assert all(t.done for t in tickets)
+    return sum(q.shape[0] for q, _ in reqs) / sec
+
+
+def run(quick: bool = False) -> None:
+    import jax
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.health import ShardHealth
+    from raft_tpu.obs import (CacheCollector, MergeDispatchCollector,
+                              MetricsRegistry, RecallProbe,
+                              SearcherCollector, ServeStatsCollector,
+                              ShardHealthCollector, Tracer)
+    from raft_tpu.serve import (BatchPolicy, BatchScheduler, BucketGrid,
+                                ResultCache, Searcher, warmup)
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    rng = np.random.default_rng(13)
+
+    if quick:
+        n, d, n_requests, max_rows, k = 1024, 16, 40, 8, 5
+        scrape_iters = 20
+    else:
+        n, d, n_requests, max_rows, k = 262_144, 128, 1500, 32, 10
+        scrape_iters = 200
+    n -= n % devs.size
+
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    health = ShardHealth(devs.size)
+    searcher = Searcher.brute_force(db, mesh=mesh, health=health,
+                                    merge_engine="auto")
+    grid = BucketGrid.pow2(max(16, max_rows), k_grid=(k,))
+    warmup(searcher, grid)
+    policy = BatchPolicy(max_batch=max(16, max_rows), max_wait=0.0,
+                         max_queue=max(64, 2 * n_requests))
+    reqs = _stream(rng, n_requests, max_rows, d, k)
+
+    # -- tracer off vs on ---------------------------------------------------
+    off = BatchScheduler(searcher, grid, policy)
+    _drive_qps(off, reqs[: max(4, n_requests // 8)])   # settle
+    qps_off = _drive_qps(off, reqs)
+    _emit("obs_tracer_off_qps", qps_off, "qps", n_requests=len(reqs),
+          mesh_devices=devs.size, n_db=n, dim=d)
+
+    tracer = Tracer(max_traces=4 * n_requests)
+    on = BatchScheduler(searcher, grid, policy, tracer=tracer)
+    _drive_qps(on, reqs[: max(4, n_requests // 8)])
+    qps_on = _drive_qps(on, reqs)
+    spans = tracer.take()
+    _emit("obs_tracer_on_qps", qps_on, "qps", n_requests=len(reqs),
+          traces=len(spans))
+    _emit("obs_tracer_overhead_pct",
+          100.0 * (qps_off - qps_on) / max(qps_off, 1e-9), "%",
+          fenced=True)
+
+    # -- scrape cost --------------------------------------------------------
+    cache = ResultCache(capacity=1024)
+    reg = MetricsRegistry()
+    ServeStatsCollector(reg, off.stats)
+    ShardHealthCollector(reg, health)
+    CacheCollector(reg, cache)
+    SearcherCollector(reg, searcher)
+    MergeDispatchCollector(reg)
+    text = reg.prometheus_text()            # populate + warm
+    t0 = time.perf_counter()
+    for _ in range(scrape_iters):
+        text = reg.prometheus_text()
+    _emit("obs_scrape_ms",
+          (time.perf_counter() - t0) / scrape_iters * 1e3, "ms",
+          lines=len(text.splitlines()), iters=scrape_iters)
+
+    # -- recall probe at 1% -------------------------------------------------
+    probe = RecallProbe(searcher, rate=0.01, seed=7,
+                        max_pending=n_requests)
+    probed = BatchScheduler(searcher, grid, policy, probe=probe)
+    _drive_qps(probed, reqs[: max(4, n_requests // 8)])
+    qps_probed = _drive_qps(probed, reqs)
+    scanned = probe.run_pending()           # the off-hot-path cost
+    _emit("obs_probe_overhead_pct",
+          100.0 * (qps_off - qps_probed) / max(qps_off, 1e-9), "%",
+          rate=0.01, sampled=probe.sampled, scanned=scanned)
+    off.close()
+    on.close()
+    probed.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

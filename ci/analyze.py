@@ -134,6 +134,7 @@ SENTINEL_SCOPE = (
     "raft_tpu/parallel/",
     "raft_tpu/serve/",
     "raft_tpu/lifecycle/",
+    "raft_tpu/obs/",
     "raft_tpu/neighbors/brute_force.py",
     "raft_tpu/matrix/select_k.py",
 )

@@ -32,4 +32,4 @@ python ci/analyze.py --stats
 python -m pytest tests/ -x -q -m "not chaos"
 python -m pytest tests/ -x -q -m "chaos"
 python -m pytest tests/ -x -q -m "sanitized"
-python -m pytest tests/test_serve.py tests/test_analyze.py -x -q
+python -m pytest tests/test_serve.py tests/test_obs.py tests/test_analyze.py -x -q

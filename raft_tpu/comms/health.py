@@ -20,7 +20,7 @@ status host-side.
 from __future__ import annotations
 
 import threading
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -52,6 +52,36 @@ class ShardHealth:
         self._lock = threading.Lock()
         self._live = np.ones(n_ranks, dtype=bool)
         self._streak = np.zeros(n_ranks, dtype=np.int64)
+        self._listeners: list = []
+
+    # -- events -----------------------------------------------------------
+    def add_listener(self, cb) -> Callable[[], None]:
+        """Subscribe ``cb(rank, live)`` to live/dead TRANSITIONS (not
+        every observation) — how the metrics layer
+        (``obs.registry.ShardHealthCollector``) counts flaps that a
+        gauge scraped between die and revive would miss.  Returns an
+        idempotent unsubscribe callable (the
+        ``Searcher.add_invalidation_hook`` contract)."""
+        with self._lock:
+            self._listeners.append(cb)
+
+        def remove() -> None:
+            with self._lock:
+                try:
+                    self._listeners.remove(cb)
+                except ValueError:
+                    pass
+
+        return remove
+
+    def _fire(self, rank: int, live: bool) -> None:
+        """Invoke listeners OUTSIDE the lock (a listener may take its
+        own lock; holding ours across foreign code invites inversions).
+        Callers pass the transition they observed inside the lock."""
+        with self._lock:
+            listeners = list(self._listeners)
+        for cb in listeners:
+            cb(rank, live)
 
     # -- feeds ------------------------------------------------------------
     def record(self, rank: int, status: StatusT) -> bool:
@@ -60,29 +90,42 @@ class ShardHealth:
         the failure streak: ABORT is cooperative cancellation — the
         shard's in-flight work is gone either way."""
         self._check_rank(rank)
+        died = False
         with self._lock:
             if status == StatusT.SUCCESS:
                 if self._live[rank]:
                     self._streak[rank] = 0
-                return bool(self._live[rank])
-            self._streak[rank] += 1
-            if self._streak[rank] >= self.failure_threshold:
-                self._live[rank] = False
-            return bool(self._live[rank])
+                alive = bool(self._live[rank])
+            else:
+                self._streak[rank] += 1
+                if self._streak[rank] >= self.failure_threshold \
+                        and self._live[rank]:
+                    self._live[rank] = False
+                    died = True
+                alive = bool(self._live[rank])
+        if died:
+            self._fire(rank, False)
+        return alive
 
     def mark_dead(self, rank: int) -> None:
         """Operator/chaos override: kill ``rank`` immediately."""
         self._check_rank(rank)
         with self._lock:
+            was_live = bool(self._live[rank])
             self._live[rank] = False
             self._streak[rank] = self.failure_threshold
+        if was_live:
+            self._fire(rank, False)
 
     def mark_live(self, rank: int) -> None:
         """Explicit revive (after the shard re-validated, e.g. reload)."""
         self._check_rank(rank)
         with self._lock:
+            was_dead = not bool(self._live[rank])
             self._live[rank] = True
             self._streak[rank] = 0
+        if was_dead:
+            self._fire(rank, True)
 
     # -- views ------------------------------------------------------------
     @property

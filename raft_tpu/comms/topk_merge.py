@@ -49,7 +49,8 @@ concat+select_k result bit-for-bit.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import threading
+from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -128,6 +129,70 @@ def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
     if engine == "ring_bf16":
         total += 2 * n_queries * cap * 4  # exact re-rank pmin/pmax
     return total
+
+
+class MergeDispatchStats:
+    """Host-side per-engine dispatch accounting for the scrape surface.
+
+    The sharded search entry points (parallel/knn.py, parallel/ivf.py)
+    call :meth:`record` once per HOST dispatch with the resolved engine
+    and the :func:`merge_comm_bytes` estimate — putting the
+    previously-bench-only exchange-volume estimator on the live metrics
+    surface (``obs.registry.MergeDispatchCollector``).  One lock + two
+    dict updates per sharded call, nothing near the device.  Counts are
+    host dispatches: a caller that wraps an entry point in its own
+    ``jax.jit``/``lax.scan`` records once per trace, not per replay
+    (same caveat as any host-side counter under tracing).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._dispatches: Dict[str, int] = {}
+        self._bytes: Dict[str, int] = {}
+        self._local = threading.local()
+
+    def suppress(self):
+        """Context manager: drop this THREAD's records while active —
+        the recall probe's shadow exact-scans dispatch through the same
+        sharded entry points, and counting them would inflate the
+        serving exchange-volume metrics with non-serving traffic."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = getattr(self._local, "off", False)
+            self._local.off = True
+            try:
+                yield
+            finally:
+                self._local.off = prev
+
+        return _ctx()
+
+    def record(self, engine: str, n_queries: int, k: int, kk: int,
+               n_dev: int, idx_bytes: int = 4) -> None:
+        if getattr(self._local, "off", False):
+            return
+        est = merge_comm_bytes(engine, n_queries, k, kk, n_dev, idx_bytes)
+        with self._lock:
+            self._dispatches[engine] = self._dispatches.get(engine, 0) + 1
+            self._bytes[engine] = self._bytes.get(engine, 0) + est
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {engine: {"dispatches": self._dispatches[engine],
+                             "est_bytes": self._bytes.get(engine, 0)}
+                    for engine in sorted(self._dispatches)}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._dispatches.clear()
+            self._bytes.clear()
+
+
+#: Process-wide recorder the sharded entry points feed (scraped via
+#: ``obs.registry.MergeDispatchCollector``; reset() is test-only).
+merge_dispatch_stats = MergeDispatchStats()
 
 
 def _ascending_keys(v, select_min: bool):

@@ -311,8 +311,12 @@ def _compact_sharded(mesh, index, policy: CompactionPolicy):
 
 
 def _warn_model_pass(policy: CompactionPolicy, what: str) -> None:
+    # Diagnostic, not an outage: routed through trace() (core/logger.py)
+    # so a policy that deliberately shares knobs across index kinds does
+    # not spam WARN on every pass — the scrape surface
+    # (obs.registry.CompactorCollector) carries the structured state.
     if policy.split_above is not None or policy.drift_threshold is not None:
-        logger.warning(
+        logger.trace(
             "split/recluster are IVF-Flat single-host passes (PQ codes "
             "are residuals against their list's center and cannot move "
             "lists without re-encoding) — ignored for %s", what)
@@ -373,37 +377,83 @@ class Compactor:
     def __init__(self, searcher, policy: Optional[CompactionPolicy] = None,
                  interval: float = 5.0,
                  sleep: Callable[[float], None] = time.sleep,
-                 pre_publish: Optional[Callable[[], None]] = None):
+                 pre_publish: Optional[Callable[[], None]] = None,
+                 drift_signal: Optional[Callable[[], bool]] = None):
         self.searcher = searcher
         self.policy = policy or CompactionPolicy()
         self.interval = interval
         self._sleep = sleep
         self._pre_publish = pre_publish
+        # Query-aware drift feed (typically ``lambda: probe.drift`` from
+        # obs/recall.py): forces a pass even below the tombstone
+        # trigger — the centroid-only trigger cannot see realized-recall
+        # decay. Pair it with a drift_threshold / split policy so the
+        # forced pass actually re-fits the model.  EDGE-triggered: one
+        # forced pass per drift episode — a level trigger would rebuild
+        # the whole index every ``interval`` for as long as the flag
+        # stays tripped (a second identical pass cannot help; the flag
+        # must clear and re-trip to force another).
+        self._drift_signal = drift_signal
+        self._drift_armed = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.passes = 0
         self.skipped = 0
         self.failures = 0
+        # Scrape surface (obs.registry.CompactorCollector): the last
+        # published report, the last failure repr, and the last trigger
+        # evaluation — a failed pass used to be one warning line,
+        # invisible to scraping (the bug class PR 3 fixed for failed
+        # batches).  Host-side values only: scrapes must not touch
+        # device state.
+        self.last_report: Optional[CompactionReport] = None
+        self.last_error: Optional[str] = None
+        self.last_should_run = False
+        self.last_trigger_frac = 0.0
 
     def should_run(self) -> bool:
-        """Tombstone fraction at or past the policy trigger."""
+        """Tombstone fraction at or past the policy trigger, or the
+        query-aware ``drift_signal`` tripped.  Records the evaluation
+        (``last_should_run`` / ``last_trigger_frac``) so the metrics
+        scrape reads host state instead of re-deriving device sums."""
         from raft_tpu.lifecycle.delete import tombstone_frac
 
         index = getattr(self.searcher, "_index", None)
-        if index is None or not getattr(index, "n_deleted", 0):
-            return False
-        return tombstone_frac(index) >= self.policy.trigger_frac
+        frac = (tombstone_frac(index)
+                if index is not None and getattr(index, "n_deleted", 0)
+                else 0.0)
+        raw_drift = (self._drift_signal is not None
+                     and bool(self._drift_signal()))
+        if not raw_drift:
+            self._drift_armed = True        # episode over: re-arm
+        drifted = raw_drift and self._drift_armed
+        self.last_trigger_frac = frac
+        self.last_should_run = (index is not None
+                                and (drifted
+                                     or frac >= self.policy.trigger_frac))
+        if self.last_should_run and drifted:
+            self._drift_armed = False       # one forced pass per episode
+        return self.last_should_run
 
     def run_once(self, force: bool = False) -> Optional[CompactionReport]:
         """One trigger check + (maybe) one pass; returns the report or
-        None when below the trigger (``force`` skips the check)."""
+        None when below the trigger (``force`` skips the check).  A
+        raising pass counts ``failures`` and records ``last_error``
+        before re-raising (the daemon loop additionally survives it)."""
         if not force and not self.should_run():
             self.skipped += 1
             return None
-        report = self.searcher.compact(self.policy,
-                                       pre_publish=self._pre_publish)
+        try:
+            report = self.searcher.compact(self.policy,
+                                           pre_publish=self._pre_publish)
+        except Exception as err:
+            self.failures += 1
+            self.last_error = repr(err)
+            raise
         if report is not None:
             self.passes += 1
+            self.last_report = report
+            self.last_error = None
         return report
 
     def start(self) -> None:
@@ -420,8 +470,9 @@ class Compactor:
                     # A failed pass (e.g. an injected pre_publish
                     # fault) published nothing — the daemon must
                     # survive to retry, not die silently while
-                    # tombstones accumulate.
-                    self.failures += 1
+                    # tombstones accumulate.  run_once already counted
+                    # ``failures`` and stamped ``last_error`` (the
+                    # scrape surface); the log line is secondary.
                     logger.warning("compaction pass failed; daemon "
                                    "continues", exc_info=True)
                 self._sleep(self.interval)

@@ -137,8 +137,11 @@ def enable_tombstones(index, mesh=None) -> None:
 
 def tombstone_frac(index) -> float:
     """Fraction of stored slots that are tombstoned — the compaction
-    trigger statistic (:class:`~raft_tpu.lifecycle.compact.Compactor`)."""
-    size = int(jnp.sum(index.list_sizes))
+    trigger statistic (:class:`~raft_tpu.lifecycle.compact.Compactor`).
+    The one device scalar is pulled via an EXPLICIT ``jax.device_get``:
+    metrics collectors call this from scraper threads, which must stay
+    legal under the sanitizer lane's ``transfer_guard("disallow")``."""
+    size = int(jax.device_get(jnp.sum(index.list_sizes)))
     return index.n_deleted / size if size else 0.0
 
 

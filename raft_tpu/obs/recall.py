@@ -1,0 +1,294 @@
+"""Online recall probe: shadow exact-scans of sampled served queries.
+
+Ref pattern: the reference measures recall offline only — gbench
+fixtures score a frozen index against precomputed ground truth
+(cpp/bench/neighbors/knn.cuh); nothing watches recall while an index
+serves and mutates.
+
+ROADMAP item 6's observability half: offline recall sweeps pin
+``n_probes`` against a frozen index, but a mutating production index
+(extend / delete / upsert / compaction — raft_tpu/lifecycle) DRIFTS:
+the centroids the coarse quantizer routes by stop matching the data,
+and realized recall decays silently while every latency metric stays
+green.  Quantized merge paths amplify the stakes (EQuARX,
+arXiv:2506.17615): an aggressive engine is only safe in production if
+realized recall is continuously measured, not assumed from an offline
+sweep.
+
+:class:`RecallProbe` closes the loop without touching the hot path:
+
+* **Deterministic sampling** — a seeded PRNG stream decides per served
+  request (arrival order is the only input), so a replayed request
+  stream probes identically; rate-limiting is structural (sampling
+  ``rate`` + a bounded pending queue that drops, never blocks).
+* **Off the hot path** — ``offer()`` (called by the scheduler at
+  request completion) only enqueues; the exact scan runs in
+  :meth:`run_pending`, driven by whatever cadence the operator owns
+  (the ``Compactor`` loop shape).  Samples whose index epoch moved
+  before the scan are discarded as stale — recall against contents the
+  request never saw would be noise.
+* **Shape-stable ground truth** — sampled queries are re-padded to
+  their serving bucket before the exact scan, so the truth programs
+  live in the same closed shape set the bucket grid warmed: probing
+  compiles nothing in steady state (the sanitized lane proves it).
+* **Drift flag** — realized recall per bucket, windowed; when any
+  bucket with enough samples falls below ``drift_below``, the
+  :attr:`drift` flag trips — the query-aware signal
+  ``Compactor(drift_signal=...)`` consumes (its centroid-only trigger
+  cannot see query-distribution drift).
+
+Ground truth: brute-force / IVF-Flat endpoints exact-scan the index
+contents (``n_probes = n_lists`` is exact over survivors); IVF-PQ
+ground truth is the full-probe PQ scan — quantization-aware recall
+(losing a neighbor to PQ rounding is indistinguishable from losing it
+to probe misses; pass ``truth_fn`` to score against source vectors).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+
+__all__ = ["RecallProbe"]
+
+BucketKey = Tuple[int, int]
+
+
+class RecallProbe:
+    """Samples served results and estimates realized recall per bucket.
+
+    Wire it in with ``BatchScheduler(..., probe=probe)`` — the scheduler
+    offers every non-degraded completion — and give ``run_pending`` a
+    cadence (a background thread, the Compactor loop, or test code).
+    With ``registry=`` the estimates publish as gauges on every scrape.
+    """
+
+    def __init__(self, searcher, *, rate: float = 0.01, seed: int = 0,
+                 max_pending: int = 64, window: int = 512,
+                 min_samples: int = 16,
+                 drift_below: Optional[float] = None,
+                 registry=None,
+                 truth_fn: Optional[Callable] = None):
+        expects(0.0 <= rate <= 1.0, "rate must be in [0, 1], got %s", rate)
+        expects(max_pending >= 1, "max_pending must be >= 1")
+        expects(window >= 1, "window must be >= 1")
+        expects(min_samples >= 1, "min_samples must be >= 1")
+        expects(drift_below is None or 0.0 < drift_below <= 1.0,
+                "drift_below must be in (0, 1], got %s", drift_below)
+        self.searcher = searcher
+        self.rate = rate
+        self.min_samples = min_samples
+        self.drift_below = drift_below
+        self._truth_fn = truth_fn
+        self._window = window
+        self._max_pending = max_pending
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._pending: deque = deque()
+        self._recalls: Dict[BucketKey, deque] = {}
+        self._drift = False
+        # Lifetime accounting (all host ints; scrape surface).
+        self.offered = 0
+        self.sampled = 0
+        self.scanned = 0
+        self.dropped = 0
+        self.stale = 0
+        self._unsub = None
+        if registry is not None:
+            self._estimate = registry.gauge(
+                "raft_recall_estimate",
+                "windowed realized recall per serving bucket",
+                labels=("bucket",))
+            self._samples_g = registry.gauge(
+                "raft_recall_samples",
+                "recall sample-window size per bucket (confidence)",
+                labels=("bucket",))
+            self._drift_g = registry.gauge(
+                "raft_recall_drift",
+                "1 when any confident bucket sits below drift_below")
+            self._counter_metrics = tuple(
+                (c, registry.counter("raft_recall_%s_total" % c,
+                                     "recall probe %s" % c))
+                for c in ("offered", "sampled", "scanned", "dropped",
+                          "stale"))
+            self._unsub = registry.register_collector(self.publish)
+        else:
+            self._estimate = self._samples_g = self._drift_g = None
+            self._counter_metrics = ()
+
+    # -- hot path (scheduler thread) ---------------------------------------
+    def offer(self, queries, k: int, indices, bucket: BucketKey,
+              epoch: int) -> bool:
+        """Maybe-sample one served request (cheap: one PRNG draw + one
+        bounded append; the exact scan happens in :meth:`run_pending`).
+        Returns whether the request was sampled."""
+        with self._lock:
+            self.offered += 1
+            if self.rate <= 0.0 or self._rng.random() >= self.rate:
+                return False
+            if len(self._pending) >= self._max_pending:
+                self.dropped += 1      # rate limit: drop, never block
+                return False
+            self.sampled += 1
+            self._pending.append((queries, int(k), indices,
+                                  (int(bucket[0]), int(bucket[1])),
+                                  int(epoch)))
+            return True
+
+    # -- shadow lane -------------------------------------------------------
+    def run_pending(self, max_items: Optional[int] = None) -> int:
+        """Exact-scan up to ``max_items`` queued samples (all by
+        default); updates the per-bucket recall windows and the drift
+        flag.  Runs on the CALLER's thread — point a background cadence
+        at it, never the serving threads.  Returns samples scored."""
+        done = 0
+        while max_items is None or done < max_items:
+            with self._lock:
+                if not self._pending:
+                    break
+                queries, k, indices, bucket, epoch = \
+                    self._pending.popleft()
+            if epoch != self.searcher.epoch:
+                with self._lock:
+                    self.stale += 1     # index moved: contents differ
+                continue
+            scores = self._score(queries, k, indices, bucket)
+            with self._lock:
+                win = self._recalls.get(bucket)
+                if win is None:
+                    win = self._recalls[bucket] = \
+                        deque(maxlen=self._window)
+                win.extend(scores)
+                self.scanned += 1
+            done += 1
+        self._refresh_drift()
+        return done
+
+    def _score(self, queries, k, indices, bucket):
+        """Per-query recall@k of the served ids against the exact
+        top-k, computed at the request's serving bucket shape (the
+        closed compiled set — steady-state probing retraces nothing)."""
+        from raft_tpu.comms.topk_merge import merge_dispatch_stats
+        from raft_tpu.serve.bucketing import pad_queries
+
+        qb, kb = bucket
+        rows = queries.shape[0]
+        padded = pad_queries(queries, qb) if rows < qb else queries
+        # Shadow scans must not count as serving traffic on the
+        # raft_merge_* scrape (they dispatch through the same sharded
+        # entry points the MergeDispatchCollector meters).
+        with merge_dispatch_stats.suppress():
+            truth = np.asarray(self._truth(padded, kb))[:rows, :k]
+        served = np.asarray(indices)[:, :k]
+        # PAD_ID (-1) fills short answers (k > live candidates); a
+        # pad-vs-pad match is not a recalled neighbor — counting it
+        # would inflate the estimate exactly when the index is most
+        # degraded (the regime the probe exists to catch).
+        return [float(np.intersect1d(served[r][served[r] >= 0],
+                                     truth[r][truth[r] >= 0]).size) / k
+                for r in range(rows)]
+
+    def _truth(self, queries, k):
+        if self._truth_fn is not None:
+            return self._truth_fn(queries, k)
+        s = self.searcher
+        if s.kind == "brute_force":
+            # Brute force IS exact — scoring it measures the serving
+            # pipeline end to end (padding/slicing/merge), recall 1.0
+            # unless something is broken.
+            return s.search(queries, k, degraded=False).indices
+        import dataclasses
+
+        from raft_tpu.serve.searcher import Searcher
+
+        # Full-probe scan over the CURRENT index snapshot: exact over
+        # survivors for IVF-Flat; the PQ tier scores in code space
+        # (module docstring).  A transient facade keeps the probe
+        # decoupled from serving state — no shared caches, no locks.
+        sp = dataclasses.replace(
+            s._params, n_probes=int(s._index.centers.shape[0]))
+        exact = Searcher(s.kind, mesh=s.mesh, index=s._index,
+                         search_params=sp, merge_engine=s.merge_engine)
+        return exact.search(queries, k, degraded=False).indices
+
+    # -- estimates ---------------------------------------------------------
+    def recall(self, bucket: Optional[BucketKey] = None) -> float:
+        """Windowed mean realized recall for one bucket (or pooled over
+        all buckets); NaN before any sample landed."""
+        with self._lock:
+            if bucket is not None:
+                win = self._recalls.get((int(bucket[0]), int(bucket[1])))
+                vals = list(win) if win else []
+            else:
+                vals = [v for win in self._recalls.values() for v in win]
+        return float(np.mean(vals)) if vals else float("nan")
+
+    def sample_count(self, bucket: Optional[BucketKey] = None) -> int:
+        with self._lock:
+            if bucket is not None:
+                win = self._recalls.get((int(bucket[0]), int(bucket[1])))
+                return len(win) if win else 0
+            return sum(len(w) for w in self._recalls.values())
+
+    def _refresh_drift(self) -> None:
+        if self.drift_below is None:
+            return
+        with self._lock:
+            tripped = False
+            for win in self._recalls.values():
+                if len(win) >= self.min_samples and \
+                        float(np.mean(win)) < self.drift_below:
+                    tripped = True
+                    break
+            self._drift = tripped
+
+    @property
+    def drift(self) -> bool:
+        """True while any confident bucket's realized recall sits below
+        ``drift_below`` — the query-aware compaction trigger
+        (``Compactor(drift_signal=lambda: probe.drift)``)."""
+        with self._lock:
+            return self._drift
+
+    def snapshot(self) -> dict:
+        """Plain-dict scrape of the probe state."""
+        with self._lock:
+            buckets = {
+                "%dx%d" % key: {"recall": float(np.mean(win)),
+                                "samples": len(win)}
+                for key, win in sorted(self._recalls.items()) if win}
+            return {"buckets": buckets, "drift": self._drift,
+                    "offered": self.offered, "sampled": self.sampled,
+                    "scanned": self.scanned, "dropped": self.dropped,
+                    "stale": self.stale,
+                    "pending": len(self._pending)}
+
+    # -- registry feed -----------------------------------------------------
+    def publish(self) -> None:
+        """Collector hook: refresh the registry gauges (registered
+        automatically when ``registry=`` was given)."""
+        if self._estimate is None:
+            return
+        snap = self.snapshot()
+        for bucket, row in snap["buckets"].items():
+            self._estimate.set(row["recall"], bucket=bucket)
+            self._samples_g.set(row["samples"], bucket=bucket)
+        self._drift_g.set(1.0 if snap["drift"] else 0.0)
+        for c, metric in self._counter_metrics:
+            metric.set_total(snap[c])
+
+    def close(self) -> None:
+        """Unhook from the registry (idempotent)."""
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    def __repr__(self) -> str:
+        return ("RecallProbe(rate=%s, scanned=%d, drift=%s)"
+                % (self.rate, self.scanned, self.drift))

@@ -39,7 +39,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.cluster import kmeans_balanced
 from raft_tpu.cluster.kmeans_types import KMeansBalancedParams
-from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
+from raft_tpu.comms.topk_merge import (
+    merge_dispatch_stats,
+    resolve_merge_engine,
+    topk_merge,
+)
 from raft_tpu.core.error import expects
 from raft_tpu.core.mdarray import validate_idx_dtype
 from raft_tpu.core.sentinels import PAD_ID
@@ -228,30 +232,37 @@ def _sharded_flat_search_jit(data, indices, sizes, centers, Q, live=None,
         tomb_l = rest.pop(0)[0] if has_tomb else None
         # Per-device top-k is bounded by this shard's slot capacity.
         kk = min(k, data_l.shape[0] * data_l.shape[1])
-        if use_cells:
-            # The PRODUCTION single-chip engine runs per shard (the
-            # reference's MNMG decomposition shards the production
-            # kernel and merges, brute_force.cuh:80 knn_merge_parts) —
-            # packed-cells Pallas scan, no probe drops, fully traced.
-            # sqrt is deferred to after the collective merge.
-            d, i = _flat._cells_search(
-                q, centers_r, data_l, idx_l, sz_l, n_probes, kk,
-                inner_is_l2, False, qrows, False, interpret,
-                deleted=tomb_l)
-        else:
-            probe_ids = _flat._coarse_probe(q, centers_r, n_probes,
-                                            inner_is_l2)
-            norms = (jnp.sum(data_l * data_l, axis=2)
-                     if inner_is_l2 else None)
-            d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
-                                     inner_is_l2, False,
-                                     probe_ids=probe_ids, deleted=tomb_l)
+        # named_scope tags the scan vs merge stages in the HLO for
+        # jax.profiler timelines — pure metadata, no operands, the
+        # compiled program is identical (obs layer contract).
+        with jax.named_scope("raft.shard_scan"):
+            if use_cells:
+                # The PRODUCTION single-chip engine runs per shard (the
+                # reference's MNMG decomposition shards the production
+                # kernel and merges, brute_force.cuh:80 knn_merge_parts) —
+                # packed-cells Pallas scan, no probe drops, fully traced.
+                # sqrt is deferred to after the collective merge.
+                d, i = _flat._cells_search(
+                    q, centers_r, data_l, idx_l, sz_l, n_probes, kk,
+                    inner_is_l2, False, qrows, False, interpret,
+                    deleted=tomb_l)
+            else:
+                probe_ids = _flat._coarse_probe(q, centers_r, n_probes,
+                                                inner_is_l2)
+                norms = (jnp.sum(data_l * data_l, axis=2)
+                         if inner_is_l2 else None)
+                d, i = _flat._probe_scan(q, data_l, norms, idx_l, sz_l, kk,
+                                         inner_is_l2, False,
+                                         probe_ids=probe_ids,
+                                         deleted=tomb_l)
         if has_live:
             alive = local_alive(alive_mask, axis)
             d, i = neutralize_dead(d, i, alive, inner_is_l2)
         # Merge the per-shard top-k inside the collective (topk_merge).
-        out_d, out_i = topk_merge(d, i, k, axis, select_min=inner_is_l2,
-                                  engine=engine)
+        with jax.named_scope("raft.topk_merge"):
+            out_d, out_i = topk_merge(d, i, k, axis,
+                                      select_min=inner_is_l2,
+                                      engine=engine)
         if inner_is_l2 and sqrt:
             out_d = jnp.sqrt(out_d)
         if not has_live:
@@ -320,14 +331,21 @@ def sharded_ivf_flat_search(
         index.indices.shape[1])
     live = (None if live_mask is None
             else check_live_mask(live_mask, mesh.shape[index.axis], mesh))
+    n_dev = mesh.shape[index.axis]
+    engine = resolve_merge_engine(merge_engine, Q.shape[0], k, n_dev)
+    # Host-side dispatch accounting for the metrics scrape (engine +
+    # estimated exchange bytes; obs.registry.MergeDispatchCollector).
+    merge_dispatch_stats.record(
+        engine, Q.shape[0], k,
+        min(k, index.indices.shape[1] * index.indices.shape[2]), n_dev,
+        idx_bytes=index.indices.dtype.itemsize)
     return _sharded_flat_search_jit(
         index.data, index.indices, index.list_sizes, index.centers, Q,
         live, index.deleted, mesh=mesh, axis=index.axis, k=k, n_probes=n_probes,
         inner_is_l2=inner_is_l2, sqrt=sqrt, use_cells=use_cells,
         qrows=min(_flat._CELL_QROWS, max(8, Q.shape[0])),
         interpret=jax.default_backend() != "tpu",
-        engine=resolve_merge_engine(merge_engine, Q.shape[0], k,
-                                    mesh.shape[index.axis]))
+        engine=engine)
 
 
 def sharded_ivf_pq_build(
@@ -422,15 +440,17 @@ def _sharded_pq_compressed_jit(codesT, invalid, indices, centers, rot,
              crot_r, q, *rest):
         codesT_l, inv_l, idx_l = codesT_l[0], inv_l[0], idx_l[0]
         kk = min(k, idx_l.shape[0] * idx_l.shape[1])
-        d, i = _pq._compressed_search(
-            q, centers_r, rot_r, codesT_l, lo_r, hi_r, inv_l, idx_l,
-            crot_r, n_probes, kk, is_ip, pq_dim, pq_bits, qrows,
-            interpret)
+        with jax.named_scope("raft.shard_scan"):
+            d, i = _pq._compressed_search(
+                q, centers_r, rot_r, codesT_l, lo_r, hi_r, inv_l, idx_l,
+                crot_r, n_probes, kk, is_ip, pq_dim, pq_bits, qrows,
+                interpret)
         if has_live:
             alive = local_alive(rest[0], axis)
             d, i = neutralize_dead(d, i, alive, not is_ip)
-        out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
-                                  engine=engine)
+        with jax.named_scope("raft.topk_merge"):
+            out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
+                                      engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
         if not has_live:
@@ -475,15 +495,18 @@ def _sharded_pq_search_jit(codes, indices, sizes, centers, rot, books, Q,
         centers_rot = jnp.matmul(centers_r, rot_r.T,
                                  precision=lax.Precision.HIGHEST)
         kk = min(k, codes_l.shape[0] * codes_l.shape[1])
-        d, i = _pq._pq_probe_scan(
-            rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip, per_cluster,
-            lut_dtype, pq_dim, pq_bits, internal_dtype,
-            pq_centers=books_r, centers_rot=centers_rot, deleted=tomb_l)
+        with jax.named_scope("raft.shard_scan"):
+            d, i = _pq._pq_probe_scan(
+                rotq, probe_ids, codes_l, idx_l, sz_l, kk, is_ip,
+                per_cluster, lut_dtype, pq_dim, pq_bits, internal_dtype,
+                pq_centers=books_r, centers_rot=centers_rot,
+                deleted=tomb_l)
         if has_live:
             alive = local_alive(alive_mask, axis)
             d, i = neutralize_dead(d, i, alive, not is_ip)
-        out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
-                                  engine=engine)
+        with jax.named_scope("raft.topk_merge"):
+            out_d, out_i = topk_merge(d, i, k, axis, select_min=not is_ip,
+                                      engine=engine)
         if sqrt:
             out_d = jnp.sqrt(jnp.maximum(out_d, 0.0))
         if not has_live:
@@ -539,6 +562,11 @@ def sharded_ivf_pq_search(
 
     engine = resolve_merge_engine(merge_engine, Q.shape[0], k,
                                   mesh.shape[index.axis])
+    # Host-side dispatch accounting — see sharded_ivf_flat_search.
+    merge_dispatch_stats.record(
+        engine, Q.shape[0], k,
+        min(k, index.indices.shape[1] * index.indices.shape[2]),
+        mesh.shape[index.axis], idx_bytes=index.indices.dtype.itemsize)
     live = (None if live_mask is None
             else check_live_mask(live_mask, mesh.shape[index.axis], mesh))
     n_lists = index.indices.shape[1]

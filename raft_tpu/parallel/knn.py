@@ -34,7 +34,11 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.util.shard_map_compat import shard_map
 
-from raft_tpu.comms.topk_merge import resolve_merge_engine, topk_merge
+from raft_tpu.comms.topk_merge import (
+    merge_dispatch_stats,
+    resolve_merge_engine,
+    topk_merge,
+)
 from raft_tpu.core.error import expects
 from raft_tpu.neighbors.brute_force import _tiled_knn_l2
 from raft_tpu.parallel.degraded import (
@@ -100,6 +104,9 @@ def sharded_knn(
     kk = min(k, shard)
     tile = min(tile_db, shard)
     engine = resolve_merge_engine(merge_engine, queries.shape[0], k, n_dev)
+    # Host-side dispatch accounting for the metrics scrape (engine +
+    # estimated exchange bytes; obs.registry.MergeDispatchCollector).
+    merge_dispatch_stats.record(engine, queries.shape[0], k, kk, n_dev)
     live = (None if live_mask is None
             else check_live_mask(live_mask, n_dev, mesh))
     return _sharded_knn_jit(db, queries, live, mesh=mesh, axis=axis, k=k,
@@ -122,14 +129,19 @@ def _sharded_knn_jit(db, queries, live, *, mesh, axis, k, kk, sqrt, tile,
 
     def local_search(db_local, q, *rest):
         # db_local: (shard, d) — this device's rows; q replicated.
-        dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
-        idx = idx + lax.axis_index(axis) * shard           # local → global ids
+        # named_scope tags the HLO so jax.profiler timelines split the
+        # per-shard scan from the merge collective — pure metadata, no
+        # operands, identical compiled program.
+        with jax.named_scope("raft.shard_scan"):
+            dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
+            idx = idx + lax.axis_index(axis) * shard       # local → global ids
         if has_live:
             dist, idx = neutralize_dead(dist, idx,
                                         local_alive(rest[0], axis), True)
         # Merge across devices inside the collective (topk_merge).
-        out_d, out_i = topk_merge(dist, idx, k, axis, select_min=True,
-                                  engine=engine)
+        with jax.named_scope("raft.topk_merge"):
+            out_d, out_i = topk_merge(dist, idx, k, axis, select_min=True,
+                                      engine=engine)
         if not has_live:
             return out_d, out_i
         # Equal rows per shard → covered fraction is the live-shard

@@ -39,6 +39,7 @@ import numpy as np
 
 from raft_tpu.core.error import RaftError, expects
 from raft_tpu.core.logger import logger
+from raft_tpu.obs.trace import NULL_SPAN, NULL_TRACER, Tracer
 from raft_tpu.serve.bucketing import BucketGrid, pad_queries
 from raft_tpu.serve.cache import ResultCache
 from raft_tpu.serve.searcher import SearchResult, Searcher
@@ -78,13 +79,18 @@ class Ticket:
     :class:`~raft_tpu.serve.searcher.SearchResult` (or re-raises the
     serving error) once done."""
 
-    __slots__ = ("_result", "_error", "_done", "seq")
+    __slots__ = ("_result", "_error", "_done", "seq", "span")
 
     def __init__(self, seq: int):
         self.seq = seq
         self._result: Optional[SearchResult] = None
         self._error: Optional[BaseException] = None
         self._done = False
+        # The request's trace root (raft_tpu/obs/trace.py) — NULL_SPAN
+        # unless the scheduler was built with a recording tracer; the
+        # full tree (queue_wait, batch_assembly, device spans, merge)
+        # is finalized when the root lands in ``tracer.take()``.
+        self.span = NULL_SPAN
 
     @property
     def done(self) -> bool:
@@ -106,15 +112,18 @@ class Ticket:
 
 class _Pending:
     __slots__ = ("queries", "k", "k_bucket", "deadline", "t_submit",
-                 "ticket")
+                 "ticket", "span", "qwait")
 
-    def __init__(self, queries, k, k_bucket, deadline, t_submit, ticket):
+    def __init__(self, queries, k, k_bucket, deadline, t_submit, ticket,
+                 span=NULL_SPAN, qwait=NULL_SPAN):
         self.queries = queries
         self.k = k
         self.k_bucket = k_bucket
         self.deadline = deadline
         self.t_submit = t_submit
         self.ticket = ticket
+        self.span = span          # request trace root
+        self.qwait = qwait        # open queue_wait child (ends at dispatch)
 
     @property
     def rows(self) -> int:
@@ -138,7 +147,9 @@ class BatchScheduler:
                  policy: BatchPolicy = BatchPolicy(),
                  cache: Optional[ResultCache] = None,
                  stats: Optional[ServeStats] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer: Optional[Tracer] = None,
+                 probe=None):
         expects(policy.max_batch <= grid.max_batch,
                 "policy.max_batch=%s exceeds the bucket grid's largest "
                 "query bucket %s — full batches would compile out-of-grid "
@@ -148,6 +159,13 @@ class BatchScheduler:
         self.policy = policy
         self.cache = cache
         self.stats = stats if stats is not None else ServeStats()
+        # Observability is opt-in and zero-cost when off: the default
+        # NULL_TRACER hands out NULL_SPAN (one enabled-check per
+        # request), and a None probe is one is-None test per completion.
+        # Inject the SAME clock into a recording tracer so span
+        # timestamps and latency stats share a timeline.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.probe = probe
         self._clock = clock
         self._queue: List[_Pending] = []
         self._lock = threading.Lock()
@@ -182,27 +200,41 @@ class BatchScheduler:
         now = self._clock()
         ticket = Ticket(next(self._seq))
         bucket = self.grid.bucket_for(q.shape[0], k) or (q.shape[0], k)
+        # One enabled-check on the admission path: the attr formatting
+        # must not run for the default NULL_TRACER (ticket.span is
+        # already NULL_SPAN).
+        root = NULL_SPAN
+        if self.tracer.enabled:
+            root = self.tracer.request(
+                "serve.request", rows=int(q.shape[0]), k=int(k),
+                bucket="%dx%d" % bucket, seq=ticket.seq)
+            ticket.span = root
 
         if self.cache is not None:
-            hit = self.cache.get(self.searcher.epoch, q, k)
+            with root.child("cache_lookup"):
+                hit = self.cache.get(self.searcher.epoch, q, k)
             if hit is not None:
                 self.stats.count(bucket, "requests")
                 self.stats.count(bucket, "cache_hits")
                 self.stats.observe_latency(bucket, 0.0)
                 ticket._complete(hit)
+                root.finish(cache="hit")
                 return ticket
 
         kb = self.grid.bucket_k(k)
+        qwait = root.child("queue_wait")
         with self._lock:       # atomic bound check + append: the shed
             pending = len(self._queue)      # point stays exact under
             admitted = pending < self.policy.max_queue  # threaded submits
             if admitted:
                 self._queue.append(_Pending(
                     q, k, kb if kb is not None else k, deadline, now,
-                    ticket))
+                    ticket, span=root, qwait=qwait))
         self.stats.count(bucket, "requests")
         if not admitted:
             self.stats.count(bucket, "shed")
+            qwait.finish()
+            root.finish(shed=True)
             raise Overloaded(
                 "queue full (%s pending >= max_queue=%s)"
                 % (pending, self.policy.max_queue))
@@ -301,15 +333,29 @@ class BatchScheduler:
     def _dispatch(self, batch: List[_Pending], kb: int, rows: int) -> None:
         qb = self.grid.bucket_queries(rows) or rows
         bucket = (qb, kb)
+        # One measurement per batch, attached to every member request's
+        # tree below (child_at): queue_wait ends here, then assembly,
+        # the searcher's fenced device spans, and result merge.
+        rec = self.tracer.enabled
+        bspan = NULL_SPAN
+        if rec:
+            for r in batch:
+                r.qwait.finish()
+            t_asm0 = self.tracer.now()
+            bspan = self.tracer.request(
+                "serve.batch", bucket="%dx%d" % bucket,
+                requests=len(batch), rows=rows, padded=qb - rows)
         big = np.concatenate([r.queries for r in batch], axis=0)
         padded = pad_queries(big, qb)
+        if rec:
+            t_asm1 = self.tracer.now()
         # Epoch captured BEFORE the search: an extend landing mid-search
         # bumps it, and caching the pre-extend result under the new
         # epoch would be a permanently-stale hit. Under the captured
         # (old) epoch the entry is unreachable by construction.
         epoch = self.searcher.epoch
         try:
-            res = self.searcher.search(padded, kb)
+            res = self.searcher.search(padded, kb, span=bspan)
         except Exception as err:   # complete, never wedge the queue
             now = self._clock()
             for r in batch:
@@ -322,6 +368,8 @@ class BatchScheduler:
                 self.stats.count(rbucket, "failed")
                 if r.deadline is not None and now > r.deadline:
                     self.stats.count(rbucket, "deadline_misses")
+                r.span.finish(error=repr(err))
+            bspan.finish(error=repr(err))
             logger.warning("serve batch %sx%s failed: %r", qb, kb, err)
             return
         now = self._clock()
@@ -332,6 +380,8 @@ class BatchScheduler:
         self.stats.count(bucket, "batched_requests", len(batch))
         self.stats.count(bucket, "batched_rows", rows)
         self.stats.count(bucket, "padded_slots", qb - rows)
+        if rec:
+            t_merge0 = self.tracer.now()
         row = 0
         for r in batch:
             sl = slice(row, row + r.rows)
@@ -355,6 +405,29 @@ class BatchScheduler:
             if r.deadline is not None and now > r.deadline:
                 self.stats.count(rbucket, "deadline_misses")
             self.stats.observe_latency(rbucket, now - r.t_submit)
+            if self.probe is not None and not res.degraded:
+                # Shadow recall sampling (obs/recall.py): enqueue-only
+                # on this thread; the exact scan runs off the hot path
+                # in probe.run_pending(). Degraded answers are skipped —
+                # partial coverage would read as recall loss.
+                self.probe.offer(r.queries, r.k, out.indices, rbucket,
+                                 epoch)
             r.ticket._complete(out)
+        if rec:
+            t_merge1 = self.tracer.now()
+            # The batch's device spans (measured once by the searcher)
+            # copy into every member's tree: a complete per-request
+            # timeline without per-request fencing.
+            device = [c for c in bspan.children
+                      if c.name in ("device_dispatch", "device_get")]
+            for r in batch:
+                r.span.child_at("batch_assembly", t_asm0, t_asm1,
+                                bucket="%dx%d" % bucket,
+                                requests=len(batch))
+                for c in device:
+                    r.span.child_at(c.name, c.start, c.end, **c.attrs)
+                r.span.child_at("result_merge", t_merge0, t_merge1)
+                r.span.finish(degraded=res.degraded)
+            bspan.finish()
         logger.trace("serve batch %sx%s: %s requests, %s rows, %s padded",
                      qb, kb, len(batch), rows, qb - rows)

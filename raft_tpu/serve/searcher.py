@@ -221,12 +221,26 @@ class Searcher:
                                      live_mask=live)
 
     def search(self, queries, k: int,
-               degraded: Optional[bool] = None) -> SearchResult:
+               degraded: Optional[bool] = None,
+               span=None) -> SearchResult:
         """One synchronous search, already shaped (the scheduler owns
         bucketing/padding). ``degraded=None`` auto-selects: the healthy
         trace while every shard is live, the live_mask trace (exact over
         survivors + coverage) as soon as the health registry reports a
-        dead rank. Retries under ``self.retry`` when set."""
+        dead rank. Retries under ``self.retry`` when set.
+
+        ``span`` (an :class:`raft_tpu.obs.trace.Span`) attaches the two
+        device-boundary child spans — ``device_dispatch`` (fenced with
+        ``jax.block_until_ready`` so the measured interval is real
+        device time, not async-dispatch enqueue time) and
+        ``device_get`` (the replicated-result pull).  With no recording
+        span the fence is SKIPPED: tracing off must not serialize the
+        dispatch pipeline, and no span machinery touches the traced
+        program either way (the compiled program is identical — the
+        sanitized lane proves it)."""
+        from raft_tpu.obs.trace import NULL_SPAN
+
+        sp = span if span is not None else NULL_SPAN
         q = np.asarray(queries)
         expects(q.ndim == 2, "queries must be (n, dim), got %s", q.shape)
         expects(q.shape[1] == self.dim, "query dim %s != index dim %s",
@@ -237,21 +251,33 @@ class Searcher:
         def attempt():
             return self._dispatch(q, k, live)
 
-        if self.retry is not None:
-            out = with_retry(attempt, self.retry, sleep=self._sleep,
-                             monotonic=self._monotonic)
-        else:
-            out = attempt()
+        import jax
+
+        with sp.child("device_dispatch", kind=self.kind,
+                      engine=self.merge_engine,
+                      sharded=self.mesh is not None) as dd:
+            if self.retry is not None:
+                out = with_retry(attempt, self.retry, sleep=self._sleep,
+                                 monotonic=self._monotonic)
+            else:
+                out = attempt()
+            if dd.recording:
+                # Fence so the span closes when the DEVICE finishes, not
+                # when XLA accepted the async dispatch — device time is
+                # real, host time stays separate.  jax.profiler picks up
+                # the same boundary for its own timeline.
+                with jax.profiler.TraceAnnotation("raft.device_fence"):
+                    jax.block_until_ready(out)
         # jax.device_get, not np.asarray: the result pull is the DECLARED
         # host boundary of the hot path, so it stays legal under the
         # sanitizer lane's jax.transfer_guard("disallow") (tests/conftest)
         # while any hidden implicit transfer inside the path still trips.
-        import jax
-
-        if len(out) == 3:
-            d, i, cov = jax.device_get(out)
+        with sp.child("device_get"):
+            host = jax.device_get(out)
+        if len(host) == 3:
+            d, i, cov = host
             return SearchResult(d, i, cov, degraded=True)
-        d, i = jax.device_get(out)
+        d, i = host
         return SearchResult(d, i, np.ones(q.shape[0], np.float32))
 
     # -- lifecycle ---------------------------------------------------------

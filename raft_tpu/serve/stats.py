@@ -96,14 +96,19 @@ class ServeStats:
 
     def snapshot(self) -> dict:
         """Plain-dict scrape of everything: per-bucket counters with
-        p50/p99 latency, plus the global compile-event count."""
+        p50/p90/p99/max latency plus the live sample-window size (so a
+        scrape consumer can judge quantile confidence — a p99 over 7
+        samples is a guess, over 4096 a measurement), plus the global
+        compile-event count."""
         with self._lock:
             buckets = {}
             for key, ctrs in self._buckets.items():
                 lat = list(self._latency[key])
                 row = dict(ctrs)
                 row["latency_p50"] = self._quantile(lat, 0.50)
+                row["latency_p90"] = self._quantile(lat, 0.90)
                 row["latency_p99"] = self._quantile(lat, 0.99)
+                row["latency_max"] = max(lat) if lat else 0.0
                 row["latency_samples"] = len(lat)
                 buckets["%dx%d" % key] = row
             return {"buckets": buckets,
