@@ -358,6 +358,19 @@ def _family_1m():
               recall_at_10=round(rec, 3), n_probes=32, engine="compressed",
               spread_pct=round(spread, 1))
 
+    # int8 LUT flag (ISSUE 14): quantized codeword tables on the same
+    # compressed tier — the recall trade recorded next to the f32 rows.
+    sp8 = ivf_pq.SearchParams(n_probes=32, engine="bucketed",
+                              bucket_cap=256, compressed_lut_int8=True)
+    pidx.compressed_scan_operands(int8_lut=True)  # cache outside loops
+    d, i = ivf_pq.search(sp8, pidx, qc, 10)
+    rec = _recall(np.asarray(i), truth["clustered"])
+    qps, spread = _eager_qps(
+        lambda qq: ivf_pq.search(sp8, pidx, qq, 10), qc)
+    _emit("ivf_pq_1m_qps_clustered_int8lut", qps, "qps", 1.0,
+          recall_at_10=round(rec, 3), n_probes=32,
+          engine="compressed+int8lut", spread_pct=round(spread, 1))
+
     spr = ivf_pq.SearchParams(n_probes=32, engine="bucketed",
                               bucket_cap=256, min_recall=0.86)
     d, i = ivf_pq.search(spr, pidx, qu, 10)

@@ -1,11 +1,21 @@
-"""Sharded-search merge-engine bench family (ISSUE 1 bench satellite).
+"""Sharded-search merge-engine bench family (ISSUE 1 bench satellite;
+ISSUE 14 adds the ``pipeline`` sub-family).
 
 Measures ``sharded_knn`` and sharded IVF-Flat search QPS per merge
-engine — allgather | ring | ring_bf16 — over the full device mesh, and
-reports each engine's estimated per-device collective exchange bytes
-(:func:`raft_tpu.comms.topk_merge.merge_comm_bytes`) so the BENCH
-trajectory records the comm-volume win alongside the throughput. One
-JSON row per (algo, engine), bench.py-style.
+engine — allgather | ring | ring_bf16 | pipelined — over the full
+device mesh, and reports each engine's estimated per-device collective
+exchange bytes (:func:`raft_tpu.comms.topk_merge.merge_comm_bytes`) so
+the BENCH trajectory records the comm-volume win alongside the
+throughput. One JSON row per (algo, engine), bench.py-style.
+
+The ``pipeline`` family separates COMPUTE time from EXPOSED-COMM time
+per engine: the compute baseline is the identical per-shard scan on a
+single-device mesh over one shard's rows (no collective in the
+program), fenced exactly like the full-mesh runs (the PR 11
+block-until-ready protocol), and ``exposed_comm_ms = total −
+compute`` — so "exchange hidden at 4+ shards" is a measured number per
+engine, not a claim. Rows: ``sharded_pipeline_ms`` with
+``phase=total|compute|exposed_comm`` per engine.
 
 ``quick=True`` is the CI smoke shape (tiny db, few repeats, runs on the
 8-virtual-CPU-device mesh in tier-1); the full shape is the tracked
@@ -20,9 +30,9 @@ import time
 import numpy as np
 
 
-def _emit(metric, value, unit, **extra):
-    rec = {"metric": metric, "value": round(float(value), 1), "unit": unit,
-           "vs_baseline": 1.0}
+def _emit(metric, value, unit, _nd: int = 1, **extra):
+    rec = {"metric": metric, "value": round(float(value), _nd),
+           "unit": unit, "vs_baseline": 1.0}
     rec.update(extra)
     print(json.dumps(rec), flush=True)
 
@@ -31,6 +41,10 @@ def _qps(fn, q, reps, rounds):
     """Pipelined eager dispatch + one fence per round, RTT-corrected —
     the bench.py _eager_qps protocol (sharded searches are eager calls
     around a jitted shard_map)."""
+    return q.shape[0] / _sec_per_call(fn, q, reps, rounds)
+
+
+def _sec_per_call(fn, q, reps, rounds):
     from bench.common import fence, link_rtt
 
     fence(fn(q))  # compile + warm
@@ -41,7 +55,7 @@ def _qps(fn, q, reps, rounds):
             out = fn(q)
         fence(out)
         times.append((time.perf_counter() - t0 - link_rtt()) / reps)
-    return q.shape[0] / float(np.median(times))
+    return float(np.median(times))
 
 
 def run(quick: bool = False) -> None:
@@ -90,6 +104,47 @@ def run(quick: bool = False) -> None:
               mesh_devices=n_dev, n_db=n, dim=d, k=k, n_probes=n_probes,
               est_exchange_bytes=merge_comm_bytes(
                   engine, nq, k, min(k, cap), n_dev))
+
+    # ---- pipeline family (ISSUE 14): compute vs exposed-comm per engine.
+    # Compute baseline: the IDENTICAL per-shard scan volume on a
+    # 1-device mesh (one shard's rows, same model shape / n_probes / k)
+    # — a compiled program with NO collective, fenced by the same
+    # protocol. exposed_comm = total − compute is then the measured
+    # exchange exposure each engine leaves on the critical path; the
+    # pipelined engines' job is driving it toward zero at 4+ shards.
+    from raft_tpu.comms.topk_merge import (pipeline_chunk_bounds,
+                                           resolve_pipeline_chunks)
+
+    mesh1 = Mesh(devs[:1], ("data",))
+    sharded1 = sharded_ivf_flat_build(mesh1, params, db[:shard],
+                                      centers=sharded.centers)
+    compute_s = _sec_per_call(
+        lambda qq: sharded_ivf_flat_search(mesh1, sp, sharded1, qq, k),
+        q, reps, rounds)
+    _emit("sharded_pipeline_ms", compute_s * 1e3, "ms", _nd=3, phase="compute",
+          engine="local_scan", mesh_devices=n_dev, n_db=n, dim=d, k=k,
+          n_probes=n_probes)
+    lcap = int(sharded.indices.shape[2])
+    for engine in ("allgather", "ring", "ring_bf16", "pipelined",
+                   "pipelined_bf16"):
+        total_s = _sec_per_call(
+            lambda qq, e=engine: sharded_ivf_flat_search(
+                mesh, sp, sharded, qq, k, merge_engine=e),
+            q, reps, rounds)
+        n_chunks = resolve_pipeline_chunks(engine, n_probes, n_dev)
+        chunk_kks = [min(k, (hi - lo) * lcap) for lo, hi in
+                     pipeline_chunk_bounds(n_probes, n_chunks)] \
+            if n_chunks > 1 else None
+        est = merge_comm_bytes(engine, nq, k, min(k, cap), n_dev,
+                               chunk_kks=chunk_kks)
+        _emit("sharded_pipeline_ms", total_s * 1e3, "ms", _nd=3, phase="total",
+              engine=engine, mesh_devices=n_dev, n_db=n, dim=d, k=k,
+              n_probes=n_probes, pipeline_chunks=n_chunks,
+              est_exchange_bytes=est)
+        _emit("sharded_pipeline_ms", max(0.0, total_s - compute_s) * 1e3,
+              "ms", _nd=3, phase="exposed_comm", engine=engine,
+              mesh_devices=n_dev, n_db=n, dim=d, k=k, n_probes=n_probes,
+              pipeline_chunks=n_chunks, est_exchange_bytes=est)
 
 
 if __name__ == "__main__":

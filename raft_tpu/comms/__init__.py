@@ -15,10 +15,14 @@ from raft_tpu.comms.health import (
 )
 from raft_tpu.comms.topk_merge import (
     MERGE_ENGINES,
+    PIPELINED_ENGINES,
     merge_comm_bytes,
     merge_parts,
+    pipeline_chunk_bounds,
     resolve_merge_engine,
+    resolve_pipeline_chunks,
     topk_merge,
+    topk_merge_pipelined,
 )
 from raft_tpu.comms.comms_test import (
     test_collective_allreduce,
@@ -39,8 +43,9 @@ from raft_tpu.comms.comms_test import (
 __all__ = [
     "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
     "inject_comms_on_handle", "ShardHealth", "checked_sync",
-    "MERGE_ENGINES", "merge_comm_bytes", "merge_parts",
-    "resolve_merge_engine", "topk_merge",
+    "MERGE_ENGINES", "PIPELINED_ENGINES", "merge_comm_bytes",
+    "merge_parts", "pipeline_chunk_bounds", "resolve_merge_engine",
+    "resolve_pipeline_chunks", "topk_merge", "topk_merge_pipelined",
     "test_collective_allreduce", "test_collective_allreduce_prod",
     "test_collective_gatherv", "test_collective_allgatherv",
     "test_collective_gather", "test_collective_broadcast",

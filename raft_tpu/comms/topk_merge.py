@@ -38,6 +38,24 @@ Engines (``topk_merge(..., engine=...)``, call INSIDE ``shard_map``):
   came from exactly one device's local list), so reported distances are
   exact and a true top-k member is lost only if bf16 rounding pushes it
   below rank 2k. Opt-in: never chosen by "auto".
+* ``"pipelined"`` / ``"pipelined_bf16"`` — the fused scan→merge
+  pipeline (:func:`topk_merge_pipelined`): the PRODUCER chunks its scan
+  over probe lists and each finished chunk's candidates ring-merge
+  while the next chunk is still scanning, so exchange latency overlaps
+  compute instead of sitting exposed after the full local scan (the
+  chunked-producer half of the fused computation-collective recipe,
+  arxiv 2305.06942 §4). Per-chunk candidate sets are DISJOINT (each
+  probed list scans in exactly one chunk), so folding the per-chunk
+  ring results under the shared total order is associative and the
+  exact variant stays bit-identical to "ring"/"allgather". The bf16
+  variant applies the ring_bf16 guard + exact re-rank PER CHUNK —
+  a true top-k member is lost only if bf16 rounding pushes it below
+  rank 2k *within its own chunk*, a strictly weaker condition than the
+  unchunked bound. Chosen by "auto" when the probe count and device
+  count make the overlap pay (:func:`resolve_merge_engine` with
+  ``n_probes``); passed to plain :func:`topk_merge` (one unchunked
+  candidate set — nothing to overlap) they degrade to the matching
+  ring engine.
 * ``"auto"`` — heuristics keyed on (q, k, n_dev); see
   :func:`resolve_merge_engine`.
 
@@ -61,7 +79,12 @@ from raft_tpu.core.sentinels import worst_value
 from raft_tpu.util.pow2 import is_pow2
 from raft_tpu.util.shard_map_compat import axis_size as _axis_size
 
-MERGE_ENGINES = ("auto", "allgather", "ring", "ring_bf16")
+MERGE_ENGINES = ("auto", "allgather", "ring", "ring_bf16", "pipelined",
+                 "pipelined_bf16")
+
+#: Engines that chunk the producer scan and overlap the exchange
+#: (resolve to a per-chunk ring via :func:`topk_merge_pipelined`).
+PIPELINED_ENGINES = ("pipelined", "pipelined_bf16")
 
 # auto crossover: below this many merged candidate scalars the latency of
 # a multi-step ring chain beats its bandwidth/distributed-select win on
@@ -69,15 +92,34 @@ MERGE_ENGINES = ("auto", "allgather", "ring", "ring_bf16")
 # allgather (see resolve_merge_engine).
 _RING_MIN_WORK = 1 << 16
 
+# Pipelined-dispatch knobs: "auto" only picks the pipelined engine when
+# the scan is long enough to hide the exchange behind (>= 4 probe lists
+# per chunk at >= 2 chunks), and each extra chunk re-exchanges up to a
+# full k-wide partial, so the chunk count is capped — 4 chunks already
+# hide ~3/4 of the exchange while bounding the volume inflation.
+_PIPELINE_MAX_CHUNKS = 4
+_PIPELINE_MIN_CHUNK_PROBES = 4
+_PIPELINE_AUTO_MIN_PROBES = 16
+_PIPELINE_AUTO_MIN_DEV = 4
+
 
 def resolve_merge_engine(engine: str, n_queries: int, k: int,
-                         n_dev: int) -> str:
+                         n_dev: int, *, n_probes: Optional[int] = None
+                         ) -> str:
     """Resolve "auto" to a concrete engine from (q, k, n_dev).
 
     Rules (documented in docs/sharded_search.md):
 
     * ``n_dev <= 2`` → "allgather": a single exchange already moves the
       minimum bytes; a ring adds steps for nothing.
+    * ``n_dev >= 4`` with a chunkable producer (``n_probes`` >= 16, the
+      IVF entry points pass their probe count) AND a merged volume
+      clearing the ``_RING_MIN_WORK`` floor → "pipelined": the scan
+      chunks over probe lists and the per-chunk ring exchange overlaps
+      the remaining chunks' compute, hiding most of the exchange
+      latency (bit-identical to "ring"). Tiny latency-bound merges
+      keep the one-shot engines — there is no scan to hide a
+      multi-chunk ring chain behind.
     * power-of-two ``n_dev >= 4`` → "ring": the butterfly moves
       ``log2(n_dev)/(n_dev-1)`` of the allgather bytes and distributes
       the select work.
@@ -86,8 +128,12 @@ def resolve_merge_engine(engine: str, n_queries: int, k: int,
       the select work pays for the longer latency chain; small merges
       stay on "allgather".
 
-    "auto" never picks "ring_bf16": quantized exchange is a numerics
-    opt-in, not a dispatch decision.
+    ``n_probes`` is the producer-chunking hint: callers whose scan
+    iterates probe lists (the sharded IVF paths) pass it so "auto" can
+    weigh the pipelined engine; without it (plain merges, brute-force
+    row scans) "auto" never picks "pipelined". "auto" never picks the
+    bf16 variants: quantized exchange is a numerics opt-in, not a
+    dispatch decision.
     """
     expects(engine in MERGE_ENGINES,
             f"unknown merge engine {engine!r} (one of {MERGE_ENGINES})")
@@ -95,13 +141,58 @@ def resolve_merge_engine(engine: str, n_queries: int, k: int,
         return engine
     if n_dev <= 2:
         return "allgather"
+    if (n_probes is not None and n_dev >= _PIPELINE_AUTO_MIN_DEV
+            and n_probes >= _PIPELINE_AUTO_MIN_PROBES
+            and n_queries * k * n_dev >= _RING_MIN_WORK):
+        # The merged-volume floor mirrors the non-pow2 ring rule: a
+        # tiny (latency-bound) merge has almost no scan to hide the
+        # multi-chunk ring chain behind, and each chunk re-exchanges a
+        # k-wide partial — small serves stay on the one-shot engines.
+        return "pipelined"
     if is_pow2(n_dev):
         return "ring"
     return "ring" if n_queries * k * n_dev >= _RING_MIN_WORK else "allgather"
 
 
+def resolve_pipeline_chunks(engine: str, n_items: Optional[int],
+                            n_dev: int, requested: int = 0) -> int:
+    """Chunk count for the pipelined engines (1 = effectively unchunked).
+
+    ``n_items`` is what the producer chunks over (probe lists for IVF,
+    row tiles for brute force); ``requested`` > 0 overrides the
+    heuristic (clamped to ``n_items``). The default targets
+    ``_PIPELINE_MIN_CHUNK_PROBES`` items per chunk, capped at
+    ``_PIPELINE_MAX_CHUNKS`` — more chunks hide marginally more latency
+    but every chunk re-exchanges a (k + guard)-wide partial.
+    """
+    if engine not in PIPELINED_ENGINES or n_dev <= 1:
+        return 1
+    if n_items is None or n_items < 2:
+        return 1
+    if requested > 0:
+        return min(requested, n_items)
+    return max(1, min(_PIPELINE_MAX_CHUNKS,
+                      n_items // _PIPELINE_MIN_CHUNK_PROBES))
+
+
+def pipeline_chunk_bounds(n_items: int, n_chunks: int):
+    """Even static split of ``n_items`` into ``n_chunks`` contiguous
+    ``(lo, hi)`` ranges, remainder spread over the leading chunks (an
+    odd ``n_items`` simply makes trailing chunks one item shorter — no
+    padding, no dropped items)."""
+    n_chunks = max(1, min(n_chunks, n_items))
+    base, rem = divmod(n_items, n_chunks)
+    bounds, lo = [], 0
+    for c in range(n_chunks):
+        hi = lo + base + (1 if c < rem else 0)
+        bounds.append((lo, hi))
+        lo = hi
+    return bounds
+
+
 def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
-                     n_dev: int, idx_bytes: int = 4) -> int:
+                     n_dev: int, idx_bytes: int = 4,
+                     chunk_kks: Optional[Sequence[int]] = None) -> int:
     """Estimated collective bytes RECEIVED per device for one merge.
 
     ``kk`` is the per-device candidate width (min(k, shard capacity)).
@@ -109,10 +200,25 @@ def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
     bf16 engine adds the exact-re-rank reduction (counted as one
     ring-allreduce of the survivor row at its guard width
     ``cap = min(2k, n_dev·kk)``: ``2·q·cap·4`` bytes).
+
+    ``chunk_kks`` describes a CHUNKED dispatch (the pipelined engines):
+    one logical merge runs N per-chunk ring exchanges at the listed
+    per-chunk candidate widths, so the estimate is the sum of the
+    per-chunk ring volumes — chunking trades some extra total bytes
+    (each chunk exchanges up to a k-wide partial) for hiding the
+    exchange behind the remaining chunks' scans. Without it the
+    pipelined engines estimate as one ring at width ``kk`` (the
+    degenerate single-chunk case).
     """
     engine = resolve_merge_engine(engine, n_queries, k, n_dev)
     if n_dev <= 1:
         return 0
+    if engine in PIPELINED_ENGINES:
+        inner = "ring_bf16" if engine == "pipelined_bf16" else "ring"
+        if not chunk_kks:
+            chunk_kks = (kk,)
+        return sum(merge_comm_bytes(inner, n_queries, k, ck, n_dev,
+                                    idx_bytes) for ck in chunk_kks)
     k_out = min(k, n_dev * kk)
     if engine == "allgather":
         return (n_dev - 1) * n_queries * kk * (4 + idx_bytes)
@@ -170,10 +276,18 @@ class MergeDispatchStats:
         return _ctx()
 
     def record(self, engine: str, n_queries: int, k: int, kk: int,
-               n_dev: int, idx_bytes: int = 4) -> None:
+               n_dev: int, idx_bytes: int = 4,
+               chunk_kks: Optional[Sequence[int]] = None) -> None:
+        """One LOGICAL merge dispatch. ``chunk_kks`` marks a chunked
+        (pipelined) dispatch: the byte estimate sums the N per-chunk
+        exchanges but the dispatch still counts ONCE — the scrape
+        reports logical merges per search call, and counting every
+        chunk exchange as a dispatch would inflate the per-query
+        exchange-byte ratio N-fold after the pipeline lands."""
         if getattr(self._local, "off", False):
             return
-        est = merge_comm_bytes(engine, n_queries, k, kk, n_dev, idx_bytes)
+        est = merge_comm_bytes(engine, n_queries, k, kk, n_dev, idx_bytes,
+                               chunk_kks=chunk_kks)
         with self._lock:
             self._dispatches[engine] = self._dispatches.get(engine, 0) + 1
             self._bytes[engine] = self._bytes.get(engine, 0) + est
@@ -289,6 +403,11 @@ def topk_merge(dist, idx, k: int, axis, select_min: bool = True,
     q, kk = dist.shape
     k_out = min(k, n_dev * kk)
     engine = resolve_merge_engine(engine, q, k, n_dev)
+    if engine in PIPELINED_ENGINES:
+        # One unchunked candidate set: there is no remaining scan to
+        # overlap, so the pipelined engines degrade to their ring core
+        # (consumers that chunk call topk_merge_pipelined instead).
+        engine = "ring_bf16" if engine == "pipelined_bf16" else "ring"
 
     if n_dev == 1:
         return _sorted_select(dist, idx, k_out, select_min)
@@ -301,10 +420,18 @@ def topk_merge(dist, idx, k: int, axis, select_min: bool = True,
     if engine == "ring":
         return _ring_merge(dist, idx, k_out, axis, select_min, n_dev)
 
-    # ring_bf16: quantized exchange with a 2k guard margin, exact re-rank.
-    # The carry STAYS bfloat16 through every ppermute hop (half the
-    # distance bytes on the wire); sorts compare bf16 directly (the bf16
-    # total order is the f32 order restricted to representable values).
+    return _bf16_guarded_ring(dist, idx, k_out, axis, select_min, n_dev)
+
+
+def _bf16_guarded_ring(dist, idx, k_out: int, axis, select_min: bool,
+                       n_dev: int):
+    """ring_bf16 core (shared with the per-chunk exchanges of
+    :func:`topk_merge_pipelined`): quantized exchange with a 2k guard
+    margin, exact re-rank. The carry STAYS bfloat16 through every
+    ppermute hop (half the distance bytes on the wire); sorts compare
+    bf16 directly (the bf16 total order is the f32 order restricted to
+    representable values)."""
+    kk = dist.shape[1]
     qd = dist.astype(jnp.bfloat16)
     cap = min(2 * k_out, n_dev * kk)
     _, surv_i = _ring_merge(qd, idx, cap, axis, select_min, n_dev)
@@ -319,6 +446,61 @@ def topk_merge(dist, idx, k: int, axis, select_min: bool = True,
         jnp.max(jnp.where(owned, dist[:, None, :], worst), axis=2)
     exact = lax.pmin(local, axis) if select_min else lax.pmax(local, axis)
     return _sorted_select(exact, surv_i, k_out, select_min)
+
+
+def topk_merge_pipelined(scan_chunk, n_chunks: int, k: int, axis,
+                         select_min: bool = True,
+                         quantized: bool = False
+                         ) -> Tuple[jax.Array, jax.Array]:
+    """Fused scan→select→exchange pipeline (the chunked-producer fused
+    computation-collective, arxiv 2305.06942): call INSIDE ``shard_map``
+    with ``scan_chunk(c) -> (dist, idx)`` producing this device's
+    best-first candidates for producer chunk ``c`` (global ids; the
+    chunks' candidate sets must be DISJOINT — each probed list / row
+    range scans in exactly one chunk).
+
+    Chunk ``c``'s per-chunk ring exchange depends only on chunk ``c``'s
+    scan, so XLA's latency-hiding scheduler overlaps it with chunk
+    ``c+1``'s compute — the double-buffered structure the eager chain
+    scan→select→merge could never express (the full merge waited on the
+    full local scan). Each device folds the replicated per-chunk merges
+    into a running (k + guard) candidate set under the shared
+    (distance, lowest-id) total order, which makes the grouping
+    associative: the exact variant is BIT-IDENTICAL to
+    ``topk_merge(concat(chunks), engine="ring"/"allgather")``.
+    ``quantized`` applies the ring_bf16 guard + exact re-rank per chunk
+    (recall bound per chunk — strictly weaker than the unchunked
+    ring_bf16 bound; distances stay exact f32 after the re-rank).
+
+    Returns replicated best-first ``(distances, ids)`` of width
+    ``min(k, Σ_c n_dev·kk_c)`` — the same width the unchunked merge of
+    the concatenated candidates would return.
+    """
+    n_dev = _axis_size(axis)
+    acc_d = acc_i = None
+    for c in range(n_chunks):
+        # named_scope per chunk: the obs layer's HLO tag splitting the
+        # chunk waves in profiler timelines (pure metadata, identical
+        # compiled program — docs/observability.md).
+        with jax.named_scope("raft.pipeline_chunk"):
+            d, i = scan_chunk(c)
+            expects(d.ndim == 2 and d.shape == i.shape,
+                    "scan_chunk must yield (n_queries, kk) candidates")
+            w_c = min(k, n_dev * d.shape[1])
+            if n_dev == 1:
+                cd, ci = _sorted_select(d, i, w_c, select_min)
+            elif quantized:
+                cd, ci = _bf16_guarded_ring(d, i, w_c, axis, select_min,
+                                            n_dev)
+            else:
+                cd, ci = _ring_merge(d, i, w_c, axis, select_min, n_dev)
+        if acc_d is None:
+            acc_d, acc_i = cd, ci
+        else:
+            acc_d, acc_i = _merge_two(
+                acc_d, acc_i, cd, ci,
+                min(k, acc_d.shape[1] + cd.shape[1]), select_min)
+    return acc_d, acc_i
 
 
 def merge_parts(keys, vals, k: Optional[int] = None,

@@ -276,7 +276,8 @@ def _compact_pq(index: "_pq.Index", policy: CompactionPolicy):
     new = dataclasses.replace(
         index, pq_codes=codes, indices=idx, list_sizes=sizes,
         deleted=None, n_deleted=0, epoch=index.epoch + 1,
-        _recon=None, _scan_ops=None)   # slot layout moved: decode caches die
+        _recon=None, _scan_ops=None,   # slot layout moved: decode caches die
+        _scan_ops_i8=None)
     return new, cap, new_cap
 
 

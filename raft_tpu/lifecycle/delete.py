@@ -115,6 +115,7 @@ def _drop_derived(index) -> None:
     compressed-scan operands) or depend on occupancy measurements."""
     if isinstance(index, _pq.Index):
         index._scan_ops = None      # embeds the invalid operand
+        index._scan_ops_i8 = None
         index.reset_search_cache()
     elif isinstance(index, _flat.Index):
         index.reset_search_cache()

@@ -122,39 +122,60 @@ _I32MAX = jnp.iinfo(jnp.int32).max
 _PATCH_ROWS = 8
 
 
+def extract_m_rows(work, ids, m: int, out_v, out_i, lane_base=0):
+    """M-pass streaming extract — the work-compression primitive of the
+    kStream select, single-sourced here and reused by the fused select
+    epilogue of the compressed PQ scan (ops/pq_scan.py).
+
+    Pulls the ``m`` smallest (value, id) pairs of each row of ``work``
+    (f32, min-order; ties to the lowest id, matching ``lax.top_k``'s
+    stable order) and places pass ``t``'s extract at lane
+    ``lane_base + t`` of ``(out_v, out_i)`` — so callers compact many
+    sub-chunks' extracts into one dense candidate block by varying
+    ``lane_base`` (static or traced). Returns ``(residual work, out_v,
+    out_i)``; extracted entries are knocked out of the residual with the
+    worst value. Rows with fewer than ``m`` finite entries repeat
+    ``(inf, min surviving id)`` for the tail passes — the same starved
+    signature the k-pass select emits, masked to the -1 sentinel by
+    every consumer's ``isinf`` epilogue."""
+    col_out = jax.lax.broadcasted_iota(jnp.int32, out_v.shape, 1)
+
+    def body_t(t, carry):
+        w, vd, vi = carry
+        cur = jnp.min(w, axis=1, keepdims=True)
+        hit = w == cur
+        sel = jnp.min(jnp.where(hit, ids, _I32MAX), axis=1,
+                      keepdims=True)
+        w = jnp.where(ids == sel, worst_value(True), w)
+        put = col_out == lane_base + t
+        vd = jnp.where(put, cur, vd)
+        vi = jnp.where(put, sel, vi)
+        return w, vd, vi
+
+    return jax.lax.fori_loop(0, m, body_t, (work, out_v, out_i))
+
+
 def _mextract_kernel(v_ref, outv_ref, outi_ref, *, n: int):
     """One (batch-block, tile) grid cell: for each of the tile's _NSUB
     sub-chunks, extract its _M smallest (value, index) pairs — ascending,
     ties to the lowest index, matching ``lax.top_k``'s stable order —
-    entirely in VMEM. Sub-chunk s's extracts land at lanes
-    [s·_M, (s+1)·_M) of the dense 128-lane candidate block, so the tile's
-    data is touched once and every output lane is real (memory-floor HBM
-    traffic; no sort network runs anywhere). All ops stay 2-D — Mosaic
-    cannot fold a (bq, _NSUB, _M) register tile into lanes."""
+    entirely in VMEM (:func:`extract_m_rows`). Sub-chunk s's extracts
+    land at lanes [s·_M, (s+1)·_M) of the dense 128-lane candidate
+    block, so the tile's data is touched once and every output lane is
+    real (memory-floor HBM traffic; no sort network runs anywhere). All
+    ops stay 2-D — Mosaic cannot fold a (bq, _NSUB, _M) register tile
+    into lanes."""
     j = pl.program_id(1)
     bq = v_ref.shape[0]
     col = jax.lax.broadcasted_iota(jnp.int32, (bq, _SUB), 1)
-    col128 = jax.lax.broadcasted_iota(jnp.int32, (bq, 128), 1)
 
     def body_sub(sub, carry):
         vd, vi = carry
         w = v_ref[:, pl.ds(sub * _SUB, _SUB)].astype(jnp.float32)
         ids = j * _BT + sub * _SUB + col
         w = jnp.where(ids < n, w, worst_value(True))
-
-        def body_t(t, c2):
-            w, vd, vi = c2
-            cur = jnp.min(w, axis=1, keepdims=True)
-            hit = w == cur
-            sel = jnp.min(jnp.where(hit, ids, _I32MAX), axis=1,
-                          keepdims=True)
-            w = jnp.where(ids == sel, worst_value(True), w)
-            put = col128 == sub * _M + t
-            vd = jnp.where(put, cur, vd)
-            vi = jnp.where(put, sel, vi)
-            return w, vd, vi
-
-        _, vd, vi = jax.lax.fori_loop(0, _M, body_t, (w, vd, vi))
+        _, vd, vi = extract_m_rows(w, ids, _M, vd, vi,
+                                   lane_base=sub * _M)
         return vd, vi
 
     vd0 = jnp.full((bq, 128), worst_value(True), jnp.float32)

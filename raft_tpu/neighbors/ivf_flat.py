@@ -905,19 +905,20 @@ def _cells_eligible(engine: str, k: int, bucket_cap: int, cap: int,
     return jax.default_backend() == "tpu" and load >= 8
 
 
-@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
-                  k: int, inner_is_l2: bool, sqrt: bool, qrows: int,
-                  qsplit: bool, interpret: bool = False, deleted=None):
-    """IVF-Flat search over packed query cells as ONE jitted program —
-    coarse probe, cells inversion, fused Pallas scan, routing and the
-    final merge (the round-4 engine treatment applied to IVF-Flat: no
-    bucket-capacity measurement, no probe drops, no eager glue)."""
+def _cells_scan_probes(Q, probe_ids, data, indices, list_sizes, k: int,
+                       inner_is_l2: bool, qrows: int, qsplit: bool,
+                       interpret: bool = False, deleted=None):
+    """Scan the GIVEN probed lists with the packed-cells Pallas engine:
+    cells inversion, fused scan, routing and the per-query merge —
+    returns best-first ``(q, k)`` candidates in true metric values (ip
+    un-negated), no sqrt. The probe-chunkable core shared by
+    :func:`_cells_search` and the sharded fused scan→merge pipeline
+    (parallel/ivf.py feeds it one probe-column chunk at a time so each
+    chunk's merge collective overlaps the next chunk's scan)."""
     from raft_tpu.ops.fused_knn import fused_cells_knn
 
     q = Q.shape[0]
     n_lists, cap, _ = data.shape
-    probe_ids = _coarse_probe(Q, centers, n_probes, inner_is_l2)
     cell_list, bucket, route = _invert_probe_map_cells(
         probe_ids, n_lists, qrows)
     Qc = Q[jnp.maximum(bucket, 0)]                 # (max_cells, qrows, d)
@@ -933,13 +934,28 @@ def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
                  jnp.maximum(bi_, 0)]
     gi = jnp.where(bi_ < 0, -1, gi)
     # The kernel reports min-selection order (ip scores negated).
-    cd, ci = _route_candidates_cells(bd_, gi, route, q, n_probes)
+    cd, ci = _route_candidates_cells(bd_, gi, route, q,
+                                     probe_ids.shape[1])
     best_d, best_i = select_k(cd, k, select_min=True, indices=ci)
-    if inner_is_l2:
-        if sqrt:
-            best_d = jnp.sqrt(best_d)
-    else:
+    if not inner_is_l2:
         best_d = -best_d
+    return best_d, best_i
+
+
+@functools.partial(jax.jit, static_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _cells_search(Q, centers, data, indices, list_sizes, n_probes: int,
+                  k: int, inner_is_l2: bool, sqrt: bool, qrows: int,
+                  qsplit: bool, interpret: bool = False, deleted=None):
+    """IVF-Flat search over packed query cells as ONE jitted program —
+    coarse probe, cells inversion, fused Pallas scan, routing and the
+    final merge (the round-4 engine treatment applied to IVF-Flat: no
+    bucket-capacity measurement, no probe drops, no eager glue)."""
+    probe_ids = _coarse_probe(Q, centers, n_probes, inner_is_l2)
+    best_d, best_i = _cells_scan_probes(Q, probe_ids, data, indices,
+                                        list_sizes, k, inner_is_l2, qrows,
+                                        qsplit, interpret, deleted)
+    if inner_is_l2 and sqrt:
+        best_d = jnp.sqrt(best_d)
     return best_d, best_i
 
 

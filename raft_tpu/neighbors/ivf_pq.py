@@ -175,6 +175,15 @@ class SearchParams:
     # (Index.reconstructed) instead of LUT gathers; "scan" is the LUT path.
     engine: str = "auto"
     bucket_cap: int = 0
+    # TPU extension (ISSUE 14): quantize the compressed-tier codeword
+    # tables to int8 with per-row symmetric scales (the fp_8bit recipe
+    # applied to the VMEM-resident codebook, ops/pq_scan.book_tables) —
+    # half the resident table bytes; the kernel dequantizes per cell.
+    # Recall-bounded, not exact: each table component moves by at most
+    # max|row|/254, the same order as the bf16 scoring noise
+    # (docs/serving.md records the measured impact). Single-chip
+    # compressed tier only; ignored by the other tiers.
+    compressed_lut_int8: bool = False
     # TPU extension: requested recall class. Plain 8-bit PQ saturates
     # near ~0.83 recall@10 on structureless query regimes (BASELINE.md);
     # a request above _REFINE_RECALL_CLASS makes search() run the
@@ -234,6 +243,9 @@ class Index:
     # Lazy compressed-scan operands (transposed codes + per-list absolute
     # codeword tables); see compressed_scan_operands(). Not serialized.
     _scan_ops: Optional[tuple] = None
+    # int8-table variant of _scan_ops (SearchParams.compressed_lut_int8);
+    # cached separately so flipping the flag never rebuilds the other.
+    _scan_ops_i8: Optional[tuple] = None
     # Reference to the dataset the index was built over, kept only while
     # the stored ids are the default global row numbering (build/extend
     # with default indices). Enables SearchParams.min_recall's internal
@@ -311,7 +323,7 @@ class Index:
         self.__dict__.pop("_auto_cap_cache", None)
         self.__dict__.pop("_conc_cache", None)
 
-    def compressed_scan_operands(self) -> tuple:
+    def compressed_scan_operands(self, int8_lut: bool = False) -> tuple:
         """Cached operands of the compressed-domain Pallas scan
         (ops/pq_scan.py): ``(codesT, lo, hi, invalid, crot_p)`` — the
         transposed packed codes (= codes size, pre-padded to the
@@ -320,10 +332,28 @@ class Index:
         ~130 KB — the per-list center component moved to the query side,
         see ops/pq_scan.book_tables), the padded slot-validity mask,
         and the permuted rotated centers the query shift needs. Rebuilt
-        lazily after extend(); PER_SUBSPACE + pq_bits∈{4,8} only."""
+        lazily after extend(); PER_SUBSPACE + pq_bits∈{4,8} only.
+        ``int8_lut`` (SearchParams.compressed_lut_int8) returns the
+        int8-quantized tables instead, with their per-row scale array
+        appended: ``(codesT, lo8, hi8, invalid, crot_p, scale)``. The
+        heavy base operands (codesT/invalid/crot_p — codes-sized) are
+        built once and SHARED by reference between the two variants;
+        only the ~130 KB tables differ per cache slot."""
+        from raft_tpu.ops.pq_scan import book_tables
+
+        if int8_lut:
+            if self._scan_ops_i8 is None:
+                codesT, _, _, invalid, crot_p = \
+                    self.compressed_scan_operands()
+                lo, hi, scale = book_tables(self.pq_centers, self.pq_bits,
+                                            int8=True)
+                ops = (codesT, lo, hi, invalid, crot_p, scale)
+                if isinstance(codesT, jax.core.Tracer):
+                    return ops
+                object.__setattr__(self, "_scan_ops_i8", ops)
+            return self._scan_ops_i8
         if self._scan_ops is None:
-            from raft_tpu.ops.pq_scan import (_SC, book_tables,
-                                              permute_subspaces)
+            from raft_tpu.ops.pq_scan import _SC, permute_subspaces
             cap = self.pq_codes.shape[1]
             capp = ceildiv(cap, _SC) * _SC
             codesT = jnp.swapaxes(self.pq_codes, 1, 2)
@@ -583,7 +613,8 @@ def _compressed_supported(index: Index) -> bool:
 def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
                        indices, crot_p, n_probes: int, k: int,
                        is_ip: bool, J: int, bits: int, qrows: int,
-                       interpret: bool = False, cell_k: int = 0):
+                       interpret: bool = False, cell_k: int = 0,
+                       int8_lut=None):
     """The compressed-domain tier as ONE jitted program — coarse probe,
     rotation, cells inversion, Pallas scan, routing and the final merge.
     Eager op-by-op orchestration of the same pipeline measured 26×
@@ -606,17 +637,39 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
     launch alone cost 82 ms vs the full 48-rank launch's 104 ms, the
     per-launch floor dominating — so the dispatch stays single-launch
     and search() maps recall classes to the bound instead."""
-    from raft_tpu.ops.pq_scan import permute_subspaces, pq_fused_scan
+    from raft_tpu.ops.pq_scan import permute_subspaces
 
-    q = Q.shape[0]
-    n_lists = centers.shape[0]
-    cell_k = cell_k or k
     probe_ids = _select_clusters((Q, centers), n_probes, is_ip)
     rotq = jnp.matmul(Q, rot.T, precision=lax.Precision.HIGHEST)
+    rotq_p = permute_subspaces(rotq, J, bits)
+    return _compressed_scan_probes(rotq_p, probe_ids, codesT, abs_lo,
+                                   abs_hi, invalid, indices, crot_p, k,
+                                   is_ip, J, bits, qrows, interpret,
+                                   cell_k=cell_k, int8_lut=int8_lut)
 
+
+def _compressed_scan_probes(rotq_p, probe_ids, codesT, abs_lo, abs_hi,
+                            invalid, indices, crot_p, k: int, is_ip: bool,
+                            J: int, bits: int, qrows: int,
+                            interpret: bool = False, cell_k: int = 0,
+                            int8_lut=None):
+    """Scan the GIVEN probed lists with the compressed-domain Pallas
+    kernel: cells inversion, residual query shift, scan, routing and the
+    per-query merge — returns best-first ``(q, k)`` candidates in true
+    metric values (ip un-negated), no sqrt. The probe-chunkable core
+    shared by :func:`_compressed_search` and the sharded fused
+    scan→merge pipeline (parallel/ivf.py feeds one probe-column chunk at
+    a time so each chunk's merge collective overlaps the next chunk's
+    scan). ``rotq_p`` is the rotated queries already in the kernel's
+    permuted subspace order. ``int8_lut`` is the optional quantized
+    codeword-table tuple (``book_tables(..., int8=True)``'s scale/zero
+    tail — abs_lo/abs_hi are then int8; see ops/pq_scan.py)."""
+    from raft_tpu.ops.pq_scan import pq_fused_scan
+
+    q, n_lists = rotq_p.shape[0], codesT.shape[0]
+    cell_k = cell_k or k
     cell_list, bucket, route = _invert_probe_map_cells(
         probe_ids, n_lists, qrows)
-    rotq_p = permute_subspaces(rotq, J, bits)
     Qc = rotq_p[jnp.maximum(bucket, 0)]            # (max_cells, qrows, d)
     safe_cl = jnp.maximum(cell_list, 0)
     if not is_ip:
@@ -627,7 +680,8 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
         Qc = Qc - crot_p[safe_cl][:, None, :]
 
     bd_, bi_ = pq_fused_scan(cell_list, Qc, codesT, abs_lo, abs_hi,
-                             invalid, cell_k, J, bits, is_ip, interpret)
+                             invalid, cell_k, J, bits, is_ip, interpret,
+                             int8_lut=int8_lut)
     if is_ip:
         # score = q·(c + cw) = q·c + q·cw; the kernel reports −(q·cw).
         # q·c is constant within a cell, so adding it after the in-cell
@@ -642,7 +696,8 @@ def _compressed_search(Q, centers, rot, codesT, abs_lo, abs_hi, invalid,
     gi = jnp.where(bi_ < 0, -1, gi)
     # The kernel reports min-selection order for both metrics (negated
     # inner products); undo the negation after the final merge.
-    cd, ci = _route_candidates_cells(bd_, gi, route, q, n_probes)
+    cd, ci = _route_candidates_cells(bd_, gi, route, q,
+                                     probe_ids.shape[1])
     best_d, best_i = select_k(cd, k, select_min=True, indices=ci)
     if is_ip:
         best_d = -best_d
@@ -992,6 +1047,7 @@ def _invalidate_caches(index: Index) -> None:
     bucket-capacity memo."""
     index._recon = None
     index._scan_ops = None
+    index._scan_ops_i8 = None
     index.reset_search_cache()
 
 
@@ -1332,13 +1388,15 @@ def search(
     # tier below instead.
     if _compressed_eligible(params, index, n_probes, k, Q.shape[0],
                             default_dtypes):
-        codesT, abs_lo, abs_hi, invalid, crot_p = \
-            index.compressed_scan_operands()
+        int8 = bool(params.compressed_lut_int8)
+        ops = index.compressed_scan_operands(int8_lut=int8)
+        codesT, abs_lo, abs_hi, invalid, crot_p = ops[:5]
         best_d, best_i = _compressed_search(
             Q, index.centers, index.rotation_matrix, codesT, abs_lo,
             abs_hi, invalid, index.indices, crot_p, n_probes, k, is_ip,
             index.pq_dim, index.pq_bits,
-            min(_CELL_QROWS, max(8, Q.shape[0])), interpret)
+            min(_CELL_QROWS, max(8, Q.shape[0])), interpret,
+            int8_lut=ops[5] if int8 else None)
         if index.metric == DistanceType.L2SqrtExpanded:
             best_d = jnp.sqrt(jnp.maximum(best_d, 0.0))
         return best_d, best_i
@@ -1492,15 +1550,21 @@ def search_refined(
                     cache[key] = float(
                         _probe_concentration(Q, index.centers))
                 bound_queue = cache[key] < _CONC_BOUND_SAFE
-        codesT, abs_lo, abs_hi, invalid, crot_p = \
-            index.compressed_scan_operands()
+        # The int8-table flag applies to the over-retrieve pass exactly
+        # like plain search() (the ineligible branch below falls back to
+        # search(), which honors it — the two branches must agree); the
+        # refine re-rank is exact either way.
+        int8 = bool(params.compressed_lut_int8)
+        ops = index.compressed_scan_operands(int8_lut=int8)
+        codesT, abs_lo, abs_hi, invalid, crot_p = ops[:5]
         _, i = _compressed_search(
             Q, index.centers, index.rotation_matrix, codesT, abs_lo,
             abs_hi, invalid, index.indices, crot_p, n_probes, pool,
             is_ip, index.pq_dim, index.pq_bits,
             min(_CELL_QROWS, max(8, Q.shape[0])),
             jax.default_backend() != "tpu",
-            min(k, pool) if bound_queue else 0)
+            min(k, pool) if bound_queue else 0,
+            int8_lut=ops[5] if int8 else None)
     else:
         _, i = search(params, index, queries, pool, handle=handle)
     return refine(dataset, queries, i, k, metric=index.metric)

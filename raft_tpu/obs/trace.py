@@ -88,6 +88,14 @@ class Span:
     def annotate(self, **attrs) -> None:
         self.attrs.update(attrs)
 
+    def now(self) -> float:
+        """The span's clock (the tracer's injected monotonic) — the
+        boundary instrumentation sites must read THIS clock when they
+        attach pre-measured ``child_at`` intervals, or exports stop
+        being deterministic under injection (Searcher.search's
+        pipeline-chunk waves use it)."""
+        return self._clock()
+
     def finish(self, **attrs) -> None:
         """Stamp the end time (idempotent — the first finish wins) and,
         for request roots, publish into the tracer's ring buffer."""
@@ -146,6 +154,9 @@ class _NullSpan:
 
     def annotate(self, **attrs):
         pass
+
+    def now(self) -> float:
+        return 0.0
 
     def finish(self, **attrs):
         pass

@@ -52,13 +52,48 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from raft_tpu.ops.fused_knn import _kpass_merge, _kpass_select
-from raft_tpu.util.pow2 import round_up_safe
+from raft_tpu.util.pow2 import ceildiv, round_up_safe
 
 _LANES = 128
 # Score-buffer width: chunks of 128 codes accumulate into a (bq, _SC)
 # buffer before each k-pass select+merge — fewer merges than per-chunk
 # selection, smaller live buffer than per-cap.
 _SC = 512
+# Fused streaming-select epilogue (the _stream_select_min machinery of
+# matrix/select_k.py folded into the scan): enabled up to this padded
+# list capacity (beyond it the tile unroll and candidate block grow past
+# the win) and from this k. Below k=8 the legacy k-pass sweep already
+# does fewer min-sweeps than the M=8 extraction floor; from k=8 up the
+# extraction compresses the select work ~1.7x at the 1M bench shape
+# (k=10, cap≈2k) and grows with k (estimated op counts; re-tune both
+# bounds from hardware timings — ROADMAP item 3 note).
+_FUSE_MAX_CAP = 4096
+_FUSE_MIN_K = 8
+
+
+def _fused_extract_m(k: int, capp: int, fuse_select: int = -1) -> int:
+    """Per-128-code-tile extract count M of the fused streaming-select
+    epilogue (0 = use the legacy k-pass group sweep).
+
+    The epilogue replaces the per-group k-pass select+merge (2k
+    min-sweeps per 512 codes) with the kStream recipe: extract the M
+    smallest of every 128-code tile into a dense candidate block (M
+    sweeps per tile), one k-pass select over the ~cap·M/128 candidates,
+    and an exactness audit whose failure re-runs the legacy sweep for
+    the cell (matrix/select_k._stream_select_min's compress→rank→audit,
+    in-kernel). M targets 2× the expected top-k density per tile
+    (2·k·128/cap) so audit fallbacks stay rare; when M >= k every
+    tile's full top-k is extracted and the audit is statically skipped.
+    ``fuse_select``: -1 auto, 0 force legacy, 1 force fused (tests).
+    """
+    if fuse_select == 0:
+        return 0
+    m = max(8, round_up_safe(ceildiv(2 * k * _LANES, capp), 8))
+    m = min(m, round_up_safe(k, 8))
+    if fuse_select != 1 and (capp > _FUSE_MAX_CAP or k < _FUSE_MIN_K
+                             or m > 64):
+        return 0
+    return m
 
 
 def subspace_perm(pq_dim: int, pq_bits: int):
@@ -84,12 +119,22 @@ def permute_subspaces(x: jax.Array, pq_dim: int, pq_bits: int) -> jax.Array:
     return x3[..., jnp.asarray(perm, jnp.int32), :].reshape(x.shape)
 
 
-def book_tables(pq_centers: jax.Array,
-                pq_bits: int) -> Tuple[jax.Array, jax.Array]:
+def book_tables(pq_centers: jax.Array, pq_bits: int, int8: bool = False):
     """Codeword tables for the gather decode, SHARED across lists:
     ``bt[0, j'·L + s, b] = books[perm[j'], b, s]`` split into two
     128-lane halves (lo, hi) over the code axis (B ≤ 128 pads lo and
     leaves hi unused).
+
+    ``int8=True`` additionally quantizes each table row symmetrically to
+    int8 (``q = round(v·127/max|v|)``) and returns ``(lo8, hi8, scale)``
+    with ``scale`` ``(1, rot_dim, 2)`` f32 (columns: lo, hi row scales)
+    — the int8 LUT flag of the fused kernel (the fp_8bit analog of
+    ivf_pq_search.cuh:70 applied to the VMEM-resident codebook): half
+    the table bytes, the kernel dequantizes per cell before the gather.
+    Error bound: each dequantized component is within ``max|row|/254``
+    of the f32 table — the same order as the bf16 scoring noise the
+    kernel already carries; docs/serving.md records the measured recall
+    impact.
 
     Round-5 redesign: the tables carry the CODEBOOK only — the per-list
     rotated-center component is subtracted from the QUERY side per cell
@@ -111,19 +156,43 @@ def book_tables(pq_centers: jax.Array,
             bt = jnp.pad(bt, ((0, 0), (0, _LANES - B)))
         # hi is never read for B <= 128 — a 1-row dummy keeps the kernel
         # operand list fixed.
-        return bt[None], bt[None, :1, :]
-    return bt[None, :, :_LANES], bt[None, :, _LANES:]
+        lo, hi = bt[None], bt[None, :1, :]
+    else:
+        lo, hi = bt[None, :, :_LANES], bt[None, :, _LANES:]
+    if not int8:
+        return lo, hi
+
+    def quant(t):
+        amax = jnp.max(jnp.abs(t), axis=2, keepdims=True)   # (1, rows, 1)
+        scale = jnp.maximum(amax, 1e-30) / 127.0
+        q = jnp.clip(jnp.round(t / scale), -127, 127).astype(jnp.int8)
+        return q, scale[0, :, 0]
+
+    lo8, lo_s = quant(lo)
+    hi8, hi_s = quant(hi)
+    # hi's scale column pads to lo's row count (the dummy-hi case).
+    hi_s = jnp.pad(hi_s, (0, lo_s.shape[0] - hi_s.shape[0]))
+    scale = jnp.stack([lo_s, hi_s], axis=1)[None]       # (1, rot_dim, 2)
+    return lo8, hi8, scale
 
 
 def _pq_scan_kernel(cell_ref, rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
-                    outd_ref, outi_ref, *, k: int, kp: int, cap: int,
-                    J: int, L: int, B: int, pq_bits: int, is_ip: bool):
+                    *refs, k: int, kp: int, cap: int,
+                    J: int, L: int, B: int, pq_bits: int, is_ip: bool,
+                    fuse_m: int, int8_lut: bool):
     """One grid cell = one packed query cell scanning one list (the
     scalar-prefetched ``cell_ref`` maps cell → list for the block index
     maps; -1 marks an unused tail cell, skipped entirely). Per 128-code
-    chunk, gather-decode the transposed absolute reconstruction from the
-    list's codebook table, score on the MXU, and fold grouped k-pass
-    selects into a carried best-k. Live VMEM is O(_SC)."""
+    chunk, gather-decode the transposed residual-scale codeword block
+    from the VMEM-resident codebook table, score on the MXU, and select
+    the cell's best-k via the fused streaming epilogue (``fuse_m`` > 0:
+    m-extract per tile → one k-pass over the compact candidates →
+    exactness audit → legacy fallback) or the legacy grouped k-pass
+    sweep. ``int8_lut`` marks int8-quantized tables with a trailing
+    per-row scale operand (book_tables(int8=True)). Live VMEM is
+    O(_SC + nc·fuse_m)."""
+    scale_ref = refs[0] if int8_lut else None
+    outd_ref, outi_ref = refs[-2], refs[-1]
     b = pl.program_id(0)
     used = cell_ref[b] >= 0
 
@@ -135,13 +204,17 @@ def _pq_scan_kernel(cell_ref, rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
     @pl.when(used)
     def _():
         _pq_scan_cell_body(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
-                           outd_ref, outi_ref, k=k, kp=kp, cap=cap, J=J,
-                           L=L, B=B, pq_bits=pq_bits, is_ip=is_ip)
+                           scale_ref, outd_ref, outi_ref, k=k, kp=kp,
+                           cap=cap, J=J, L=L, B=B, pq_bits=pq_bits,
+                           is_ip=is_ip, fuse_m=fuse_m)
 
 
 def _pq_scan_cell_body(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
-                       outd_ref, outi_ref, *, k: int, kp: int, cap: int,
-                       J: int, L: int, B: int, pq_bits: int, is_ip: bool):
+                       scale_ref, outd_ref, outi_ref, *, k: int, kp: int,
+                       cap: int, J: int, L: int, B: int, pq_bits: int,
+                       is_ip: bool, fuse_m: int):
+    from raft_tpu.matrix.select_k import extract_m_rows
+
     rotq = rotq_ref[0]                              # (bq, rot) f32
     bq, rot = rotq.shape
     rqb = rotq.astype(jnp.bfloat16)
@@ -149,61 +222,133 @@ def _pq_scan_cell_body(rotq_ref, codesT_ref, lo_ref, hi_ref, bad_ref,
         qn = jnp.zeros((bq, 1), jnp.float32)
     else:
         qn = jnp.sum(rotq * rotq, axis=1, keepdims=True)
-    lo = lo_ref[0]                                  # (rot, 128) f32
-    hi = hi_ref[0]
-    colsc = jax.lax.broadcasted_iota(jnp.int32, (bq, _SC), 1)
+    if scale_ref is None:
+        lo = lo_ref[0]                              # (rot, 128) f32
+        hi = hi_ref[0]
+    else:
+        # int8 LUT: dequantize the resident tables once per cell with
+        # their per-row symmetric scales (book_tables(int8=True)) — the
+        # gathers below then run against the f32 reconstruction.
+        sc = scale_ref[0]                           # (rot, 2) f32
+        lo = lo_ref[0].astype(jnp.float32) * sc[:, 0:1]
+        hi = (hi_ref[0].astype(jnp.float32) * sc[:, 1:2]
+              if B > _LANES else hi_ref[0].astype(jnp.float32))
 
-    def group(gi_, carry):
-        nd, ni = carry
-        g0 = gi_ * _SC
+    def chunk_scores(c0):
+        """Gather-decode + MXU-score the 128 codes at [c0, c0+128) —
+        min-order (bq, 128) f32 scores, shared by both epilogues."""
+        raw = codesT_ref[0, :, pl.ds(c0, _LANES)].astype(jnp.int32)
+        if pq_bits == 8:
+            cj = raw                                # (J, 128)
+        else:                                       # 4: [all lo | all hi]
+            cj = jnp.concatenate([raw & 0xF, raw >> 4], axis=0)
+        idx = jnp.broadcast_to(cj[:, None, :],
+                               (J, L, _LANES)).reshape(rot, _LANES)
+        glo = jnp.take_along_axis(lo, jnp.clip(idx, 0, _LANES - 1),
+                                  axis=1)
+        if B > _LANES:
+            ghi = jnp.take_along_axis(
+                hi, jnp.clip(idx - _LANES, 0, _LANES - 1), axis=1)
+            cwT = jnp.where(idx >= _LANES, ghi, glo)
+        else:
+            cwT = glo                               # (rot, 128) f32
+        g = jax.lax.dot_general(                    # (bq, 128) f32
+            rqb, cwT.astype(jnp.bfloat16),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if is_ip:
+            return -g
+        cwn = jnp.sum(cwT * cwT, axis=0, keepdims=True)  # (1, 128)
+        return jnp.maximum(qn + cwn - 2.0 * g, 0.0)
 
-        def chunk(ci):
-            c0 = g0 + ci * _LANES
-            raw = codesT_ref[0, :, pl.ds(c0, _LANES)].astype(jnp.int32)
-            if pq_bits == 8:
-                cj = raw                            # (J, 128)
-            else:                                   # 4: [all lo | all hi]
-                cj = jnp.concatenate([raw & 0xF, raw >> 4], axis=0)
-            idx = jnp.broadcast_to(cj[:, None, :],
-                                   (J, L, _LANES)).reshape(rot, _LANES)
-            glo = jnp.take_along_axis(lo, jnp.clip(idx, 0, _LANES - 1),
-                                      axis=1)
-            if B > _LANES:
-                ghi = jnp.take_along_axis(
-                    hi, jnp.clip(idx - _LANES, 0, _LANES - 1), axis=1)
-                cwT = jnp.where(idx >= _LANES, ghi, glo)
-            else:
-                cwT = glo                           # (rot, 128) f32 absolute
-            g = jax.lax.dot_general(                # (bq, 128) f32
-                rqb, cwT.astype(jnp.bfloat16),
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            if is_ip:
-                return -g
-            cwn = jnp.sum(cwT * cwT, axis=0, keepdims=True)  # (1, 128)
-            return jnp.maximum(qn + cwn - 2.0 * g, 0.0)
+    def legacy_sweep():
+        """The grouped k-pass select+merge epilogue (pre-fusion design;
+        also the audit-failure fallback of the fused path)."""
+        colsc = jax.lax.broadcasted_iota(jnp.int32, (bq, _SC), 1)
 
-        work = jnp.concatenate(
-            [chunk(ci) for ci in range(_SC // _LANES)], axis=1)
-        bad = bad_ref[0, :, pl.ds(g0, _SC)]         # (1, _SC)
-        work = jnp.where(bad, jnp.inf, work)
-        td, ti = _kpass_select(work, g0 + colsc, k, kp)
-        return _kpass_merge(nd, ni, td, ti, k, kp)
+        def group(gi_, carry):
+            nd, ni = carry
+            g0 = gi_ * _SC
+            work = jnp.concatenate(
+                [chunk_scores(g0 + ci * _LANES)
+                 for ci in range(_SC // _LANES)], axis=1)
+            bad = bad_ref[0, :, pl.ds(g0, _SC)]     # (1, _SC)
+            work = jnp.where(bad, jnp.inf, work)
+            td, ti = _kpass_select(work, g0 + colsc, k, kp)
+            return _kpass_merge(nd, ni, td, ti, k, kp)
 
-    nd0 = jnp.full((bq, kp), jnp.inf, jnp.float32)
-    ni0 = jnp.full((bq, kp), -1, jnp.int32)
-    nd, ni = jax.lax.fori_loop(0, cap // _SC, group, (nd0, ni0))
-    ni = jnp.where(jnp.isinf(nd), -1, ni)           # starved-list sentinel
-    outd_ref[0] = nd
-    outi_ref[0] = ni
+        nd0 = jnp.full((bq, kp), jnp.inf, jnp.float32)
+        ni0 = jnp.full((bq, kp), -1, jnp.int32)
+        return jax.lax.fori_loop(0, cap // _SC, group, (nd0, ni0))
+
+    def write(nd, ni):
+        outd_ref[0] = nd
+        outi_ref[0] = jnp.where(jnp.isinf(nd), -1, ni)  # starved sentinel
+
+    if fuse_m == 0:
+        nd, ni = legacy_sweep()
+        write(nd, ni)
+        return
+
+    # Fused streaming-select epilogue — _stream_select_min's
+    # compress→rank→audit folded into the scan (matrix/select_k.py):
+    # extract each 128-code tile's fuse_m smallest into a dense
+    # candidate block while the tile's scores are still in registers,
+    # then ONE k-pass over the ~cap·m/128 candidates instead of 2k
+    # min-sweeps per 512-code group.
+    nc = cap // _LANES
+    ncp = round_up_safe(nc * fuse_m, _LANES)
+    col128 = jax.lax.broadcasted_iota(jnp.int32, (bq, _LANES), 1)
+    cand_v = jnp.full((bq, ncp), jnp.inf, jnp.float32)
+    cand_i = jnp.full((bq, ncp), -1, jnp.int32)
+    for ci in range(nc):
+        c0 = ci * _LANES
+        w = chunk_scores(c0)
+        w = jnp.where(bad_ref[0, :, pl.ds(c0, _LANES)], jnp.inf, w)
+        _, cand_v, cand_i = extract_m_rows(w, c0 + col128, fuse_m,
+                                           cand_v, cand_i,
+                                           lane_base=ci * fuse_m)
+    nd, ni = _kpass_select(cand_v, cand_i, k, kp)
+
+    if fuse_m >= k:
+        # Every tile's full top-k was extracted — statically exact.
+        write(nd, ni)
+        return
+
+    # Exactness audit (the _stream_select_min audit in-kernel): tile
+    # extracts are ascending, so lane m-1 of each tile's block is its
+    # worst extract; a tile can hide a better element only if that
+    # worst still ties-or-beats the candidate k-th (<= keeps tie order
+    # identical to the legacy sweep's lowest-id rule). An +inf worst
+    # means the tile had fewer than m finite entries — fully extracted,
+    # exact regardless of the k-th (starved lists must not fall back).
+    colnc = jax.lax.broadcasted_iota(jnp.int32, (bq, ncp), 1)
+    worst_lane = (colnc % fuse_m == fuse_m - 1) & (colnc < nc * fuse_m)
+    aud = jnp.min(jnp.where(worst_lane, cand_v, jnp.inf), axis=1,
+                  keepdims=True)                    # (bq, 1)
+    colkp = jax.lax.broadcasted_iota(jnp.int32, (bq, kp), 1)
+    kth = jnp.max(jnp.where(colkp == k - 1, nd, -jnp.inf), axis=1,
+                  keepdims=True)                    # (bq, 1)
+    ok = jnp.all((aud > kth) | jnp.isinf(aud))
+
+    @pl.when(ok)
+    def _():
+        write(nd, ni)
+
+    @pl.when(jnp.logical_not(ok))
+    def _():
+        nd2, ni2 = legacy_sweep()
+        write(nd2, ni2)
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("k", "J", "pq_bits", "is_ip", "interpret"))
+    static_argnames=("k", "J", "pq_bits", "is_ip", "interpret",
+                     "fuse_select"))
 def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
                   k: int, J: int, pq_bits: int, is_ip: bool,
-                  interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
+                  interpret: bool = False, int8_lut=None,
+                  fuse_select: int = -1) -> Tuple[jax.Array, jax.Array]:
     """Batched compressed-domain PQ scan over PACKED query cells.
 
     cell_list: (max_cells,) int32 — the list each cell scans (-1 =
@@ -215,13 +360,19 @@ def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
     residual-scale operand convention of book_tables — the caller owns
     the shift, ivf_pq._compressed_search). codesT: (n_lists, nbytes,
     cap) u8 transposed packed rows. abs_lo / abs_hi: (1, rot_dim, 128)
-    f32 shared codeword tables (book_tables). invalid: (n_lists, cap)
-    bool. Returns (distances (max_cells, qrows, k), local slot ids).
-    L2 metrics report squared RESIDUAL distances ‖(q−c) − codeword‖²
-    (≡ the absolute ADC distance, computed at residual scale); is_ip
-    reports negated codeword inner products — the caller adds the
-    per-(query, list) q·c term after (constant within a cell, so
-    in-cell selection order is unaffected).
+    f32 shared codeword tables (book_tables), or int8 with the per-row
+    scale array passed as ``int8_lut`` (``book_tables(..., int8=True)``
+    — the int8 LUT flag: half the resident table bytes, recall bounded
+    by the per-row quantization step; docs/serving.md). invalid:
+    (n_lists, cap) bool. ``fuse_select`` picks the in-kernel selection
+    epilogue (-1 auto / 0 legacy k-pass / 1 fused streaming — see
+    :func:`_fused_extract_m`; both epilogues are exact and
+    bit-identical). Returns (distances (max_cells, qrows, k), local
+    slot ids). L2 metrics report squared RESIDUAL distances
+    ‖(q−c) − codeword‖² (≡ the absolute ADC distance, computed at
+    residual scale); is_ip reports negated codeword inner products —
+    the caller adds the per-(query, list) q·c term after (constant
+    within a cell, so in-cell selection order is unaffected).
     """
     max_cells, qrows, rot_dim = rotq_cells.shape
     nbytes, cap = codesT.shape[1], codesT.shape[2]
@@ -236,36 +387,49 @@ def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
                           constant_values=True)
     if qr != qrows:
         rotq_cells = jnp.pad(rotq_cells, ((0, 0), (0, qr - qrows), (0, 0)))
+    fuse_m = _fused_extract_m(k, capp, fuse_select)
 
     kernel = functools.partial(
         _pq_scan_kernel, k=k, kp=kp, cap=capp, J=J, L=L, B=B,
-        pq_bits=pq_bits, is_ip=is_ip)
+        pq_bits=pq_bits, is_ip=is_ip, fuse_m=fuse_m,
+        int8_lut=int8_lut is not None)
 
     def by_list(b, cl):
         return (jnp.maximum(cl[b], 0), 0, 0)
 
+    in_specs = [
+        pl.BlockSpec((1, qr, rot_dim), lambda b, cl: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, nbytes, capp), by_list,
+                     memory_space=pltpu.VMEM),
+        # Codeword tables are SHARED across lists (constant block —
+        # stays VMEM-resident across the whole grid).
+        pl.BlockSpec((1, rot_dim, _LANES), lambda b, cl: (0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        # hi half of the code axis — a 1-row dummy when B <= 128
+        # (the kernel statically never reads it).
+        pl.BlockSpec((1, abs_hi.shape[1], _LANES),
+                     lambda b, cl: (0, 0, 0),
+                     memory_space=pltpu.VMEM),
+        # A middle unit axis keeps the mask block's trailing two dims
+        # (1, capp) legal for the mosaic lowering (see fused_knn).
+        pl.BlockSpec((1, 1, capp), by_list,
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [cell_list, rotq_cells, codesT, abs_lo, abs_hi,
+                invalid[:, None, :]]
+    if int8_lut is not None:
+        # Per-row dequantization scales for the int8 tables — another
+        # shared constant block.
+        in_specs.append(pl.BlockSpec((1, rot_dim, 2),
+                                     lambda b, cl: (0, 0, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(int8_lut)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(max_cells,),
-        in_specs=[
-            pl.BlockSpec((1, qr, rot_dim), lambda b, cl: (b, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, nbytes, capp), by_list,
-                         memory_space=pltpu.VMEM),
-            # Codeword tables are SHARED across lists (constant block —
-            # stays VMEM-resident across the whole grid).
-            pl.BlockSpec((1, rot_dim, _LANES), lambda b, cl: (0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            # hi half of the code axis — a 1-row dummy when B <= 128
-            # (the kernel statically never reads it).
-            pl.BlockSpec((1, abs_hi.shape[1], _LANES),
-                         lambda b, cl: (0, 0, 0),
-                         memory_space=pltpu.VMEM),
-            # A middle unit axis keeps the mask block's trailing two dims
-            # (1, capp) legal for the mosaic lowering (see fused_knn).
-            pl.BlockSpec((1, 1, capp), by_list,
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, qr, kp), lambda b, cl: (b, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -281,5 +445,5 @@ def pq_fused_scan(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid,
             jax.ShapeDtypeStruct((max_cells, qr, kp), jnp.int32),
         ],
         interpret=interpret,
-    )(cell_list, rotq_cells, codesT, abs_lo, abs_hi, invalid[:, None, :])
+    )(*operands)
     return outd[:, :qrows, :k], outi[:, :qrows, :k]

@@ -88,6 +88,51 @@ def live_args(live):
     return () if live is None else (live,)
 
 
+def scan_merge_dispatch(scan_range, chunks, chunk_width, full_kk: int,
+                        engine: str, k: int, axis, select_min: bool,
+                        alive=None):
+    """The shared scan→merge dispatch of every sharded search body
+    (brute-force rows, IVF-Flat both tiers, IVF-PQ both tiers): run the
+    per-shard scan and merge through the engine's collective, chunking
+    the scan and overlapping per-chunk exchanges when ``engine`` is
+    pipelined (comms.topk_merge_pipelined — the fused
+    scan→select→exchange pipeline, docs/sharded_search.md §pipelined).
+    One definition so the pipeline contract (chunk slicing, per-chunk
+    dead-shard neutralization, HLO stage tags, the quantized-variant
+    flag) cannot drift between the four bodies.
+
+    ``scan_range(lo, hi, kk)`` scans producer items [lo, hi) (probe
+    columns / row tiles) at candidate width ``kk``; ``chunks`` is the
+    static (lo, hi) split (``pipeline_chunk_bounds``); ``chunk_width``
+    maps (lo, hi) to a chunk's candidate width; ``full_kk`` is the
+    eager chain's width (NOT necessarily ``chunk_width`` over the full
+    range — the historical eager trace clamps by total capacity, and
+    changing it would change the compiled program). ``alive`` is this
+    shard's traced liveness scalar (None = no liveness operand)."""
+    from raft_tpu.comms.topk_merge import (PIPELINED_ENGINES, topk_merge,
+                                           topk_merge_pipelined)
+
+    def one(lo, hi, kk):
+        # named_scope tags the scan stage in the HLO for jax.profiler
+        # timelines — pure metadata, identical compiled program.
+        with jax.named_scope("raft.shard_scan"):
+            d, i = scan_range(lo, hi, kk)
+        if alive is not None:
+            d, i = neutralize_dead(d, i, alive, select_min)
+        return d, i
+
+    if engine in PIPELINED_ENGINES and len(chunks) > 1:
+        return topk_merge_pipelined(
+            lambda c: one(chunks[c][0], chunks[c][1],
+                          chunk_width(chunks[c][0], chunks[c][1])),
+            len(chunks), k, axis, select_min=select_min,
+            quantized=engine == "pipelined_bf16")
+    d, i = one(chunks[0][0], chunks[-1][1], full_kk)
+    with jax.named_scope("raft.topk_merge"):
+        return topk_merge(d, i, k, axis, select_min=select_min,
+                          engine=engine)
+
+
 def probed_coverage(probe_ids, sz_l, alive, axis):
     """Per-query coverage: fraction of the probed candidate rows that
     live on surviving shards. Every shard probes the same lists (the

@@ -36,8 +36,9 @@ from raft_tpu.util.shard_map_compat import shard_map
 
 from raft_tpu.comms.topk_merge import (
     merge_dispatch_stats,
+    pipeline_chunk_bounds,
     resolve_merge_engine,
-    topk_merge,
+    resolve_pipeline_chunks,
 )
 from raft_tpu.core.error import expects
 from raft_tpu.neighbors.brute_force import _tiled_knn_l2
@@ -46,8 +47,9 @@ from raft_tpu.parallel.degraded import (
     live_args,
     live_specs,
     local_alive,
-    neutralize_dead,
+    neutralize_dead,  # noqa: F401  (re-exported via raft_tpu.parallel)
     replicated,
+    scan_merge_dispatch,
 )
 
 
@@ -77,13 +79,21 @@ def sharded_knn(
     tile_db: int = 8192,
     merge_engine: str = "auto",
     live_mask=None,
+    pipeline_chunks: int = 0,
 ):
     """Exact L2 kNN with the database row-sharded over ``mesh[axis]``.
 
     ``db`` rows must be divisible by the axis size (pad upstream if not;
     static shapes). Returns replicated ``(distances (q,k), indices (q,k))``
     with global row ids. ``merge_engine`` picks the top-k merge collective
-    (see comms/topk_merge.py): "allgather", "ring", "ring_bf16" or "auto".
+    (see comms/topk_merge.py): "allgather", "ring", "ring_bf16",
+    "pipelined", "pipelined_bf16" or "auto". The pipelined engines chunk
+    each shard's row scan into ``pipeline_chunks`` tiles (0 = the
+    resolve_pipeline_chunks default) and overlap each finished tile's
+    ring exchange with the next tile's scan — bit-identical results
+    (docs/sharded_search.md §pipeline); "auto" here never picks them
+    (the brute-force scan has no probe structure to key the heuristic
+    on — opt in explicitly).
 
     ``live_mask`` (bool (n_dev,), e.g. ``ShardHealth.live_mask``) enables
     degraded serving: dead shards contribute nothing, the result is the
@@ -104,22 +114,30 @@ def sharded_knn(
     kk = min(k, shard)
     tile = min(tile_db, shard)
     engine = resolve_merge_engine(merge_engine, queries.shape[0], k, n_dev)
+    chunks = tuple(pipeline_chunk_bounds(
+        shard, resolve_pipeline_chunks(engine, shard, n_dev,
+                                       requested=pipeline_chunks)))
     # Host-side dispatch accounting for the metrics scrape (engine +
     # estimated exchange bytes; obs.registry.MergeDispatchCollector).
-    merge_dispatch_stats.record(engine, queries.shape[0], k, kk, n_dev)
+    # A chunked dispatch records ONE logical merge whose estimate sums
+    # the per-chunk exchanges (comms/topk_merge.py).
+    merge_dispatch_stats.record(
+        engine, queries.shape[0], k, kk, n_dev,
+        chunk_kks=([min(k, hi - lo) for lo, hi in chunks]
+                   if len(chunks) > 1 else None))
     live = (None if live_mask is None
             else check_live_mask(live_mask, n_dev, mesh))
     return _sharded_knn_jit(db, queries, live, mesh=mesh, axis=axis, k=k,
                             kk=kk, sqrt=sqrt, tile=tile, shard=shard,
-                            engine=engine)
+                            engine=engine, chunks=chunks)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "axis", "k", "kk", "sqrt", "tile", "shard",
-                     "engine"))
+                     "engine", "chunks"))
 def _sharded_knn_jit(db, queries, live, *, mesh, axis, k, kk, sqrt, tile,
-                     shard, engine):
+                     shard, engine, chunks=((0, 0),)):
     # jit around shard_map is load-bearing: an un-jitted shard_map runs in
     # the eager SPMD interpreter (~10x slower, measured on the CPU mesh).
     # ``live=None`` traces the exact pre-fault-tolerance program (two
@@ -129,19 +147,22 @@ def _sharded_knn_jit(db, queries, live, *, mesh, axis, k, kk, sqrt, tile,
 
     def local_search(db_local, q, *rest):
         # db_local: (shard, d) — this device's rows; q replicated.
-        # named_scope tags the HLO so jax.profiler timelines split the
-        # per-shard scan from the merge collective — pure metadata, no
-        # operands, identical compiled program.
-        with jax.named_scope("raft.shard_scan"):
-            dist, idx = _tiled_knn_l2(q, db_local, kk, sqrt, tile, True)
-            idx = idx + lax.axis_index(axis) * shard       # local → global ids
-        if has_live:
-            dist, idx = neutralize_dead(dist, idx,
-                                        local_alive(rest[0], axis), True)
-        # Merge across devices inside the collective (topk_merge).
-        with jax.named_scope("raft.topk_merge"):
-            out_d, out_i = topk_merge(dist, idx, k, axis, select_min=True,
-                                      engine=engine)
+        alive = local_alive(rest[0], axis) if has_live else None
+
+        def scan_range(lo, hi, kk_c):
+            # One row-tile scan; with the pipelined engines each tile's
+            # ring exchange overlaps the next tile's scan (chunks are
+            # disjoint row ranges, so results stay bit-identical to the
+            # eager chain — scan_merge_dispatch).
+            d_c, i_c = _tiled_knn_l2(q, db_local[lo:hi], kk_c, sqrt,
+                                     min(tile, hi - lo), True)
+            return d_c, i_c + (lax.axis_index(axis) * shard + lo)
+
+        out_d, out_i = scan_merge_dispatch(
+            scan_range, chunks,
+            chunk_width=lambda lo, hi: min(kk, hi - lo),
+            full_kk=kk, engine=engine, k=k, axis=axis, select_min=True,
+            alive=alive)
         if not has_live:
             return out_d, out_i
         # Equal rows per shard → covered fraction is the live-shard

@@ -187,6 +187,40 @@ class Searcher:
             return self.health.live_mask
         return None
 
+    def _pipeline_plan(self, n_queries: int, k: int):
+        """``(resolved engine, n_chunks)`` when this searcher's next
+        dispatch runs the fused scan→merge pipeline, else None — the
+        SAME resolution the sharded entry points apply (single-sourced
+        helpers in comms/topk_merge.py), so the span annotation below
+        and the metrics scrape describe the program actually served."""
+        if self.mesh is None:
+            return None
+        from raft_tpu.comms.topk_merge import (PIPELINED_ENGINES,
+                                               resolve_merge_engine,
+                                               resolve_pipeline_chunks)
+
+        axis = getattr(self._index, "axis", "data")
+        n_dev = self.mesh.shape[axis]
+        if self.kind == "brute_force":
+            n_probes = None
+            n_items = int(self._db.shape[0]) // n_dev
+        else:
+            n_probes = min(self._params.n_probes,
+                           int(self._index.centers.shape[0]))
+            n_items = n_probes
+        engine = resolve_merge_engine(self.merge_engine, n_queries, k,
+                                      n_dev, n_probes=n_probes)
+        if engine not in PIPELINED_ENGINES:
+            return None
+        n_chunks = resolve_pipeline_chunks(engine, n_items, n_dev)
+        if n_chunks <= 1:
+            # The dispatch degraded to the unchunked ring
+            # (scan_merge_dispatch pipelines only at 2+ chunks) — a
+            # chunk-wave annotation here would claim a program that
+            # did not run.
+            return None
+        return engine, n_chunks
+
     def _dispatch(self, queries: np.ndarray, k: int, live):
         if self.kind == "brute_force":
             if self.mesh is None:
@@ -268,6 +302,26 @@ class Searcher:
                 # the same boundary for its own timeline.
                 with jax.profiler.TraceAnnotation("raft.device_fence"):
                     jax.block_until_ready(out)
+                plan = self._pipeline_plan(q.shape[0], k)
+                if plan is not None:
+                    # One child span per pipeline chunk WAVE (the fused
+                    # scan→merge pipeline, docs/sharded_search.md): the
+                    # waves run inside one compiled program, so the
+                    # host splits the fenced device window evenly —
+                    # estimated=True marks the boundaries as synthetic
+                    # (the HLO-level truth is the
+                    # "raft.pipeline_chunk" named_scope tags in the
+                    # profiler timeline).
+                    engine, n_chunks = plan
+                    dd.annotate(pipeline_chunks=n_chunks)
+                    t1 = dd.now()
+                    step = (t1 - dd.start) / max(n_chunks, 1)
+                    for c in range(n_chunks):
+                        dd.child_at("pipeline_chunk",
+                                    dd.start + c * step,
+                                    dd.start + (c + 1) * step,
+                                    chunk=c, engine=engine,
+                                    estimated=True)
         # jax.device_get, not np.asarray: the result pull is the DECLARED
         # host boundary of the hot path, so it stays legal under the
         # sanitizer lane's jax.transfer_guard("disallow") (tests/conftest)

@@ -95,8 +95,15 @@ def _golden_trace() -> Tracer:
     qw.finish()
     root.child_at("batch_assembly", 0.005, 0.006, bucket="4x8",
                   requests=2)
-    root.child_at("device_dispatch", 0.006, 0.009, kind="brute_force",
-                  engine="auto", sharded=True)
+    dd = root.child_at("device_dispatch", 0.006, 0.009, kind="brute_force",
+                       engine="auto", sharded=True, pipeline_chunks=2)
+    # Chunk waves of the fused scan→merge pipeline (ISSUE 14): evenly
+    # split synthetic intervals under the fenced dispatch window, the
+    # shape Searcher.search attaches when the pipelined engine serves.
+    dd.child_at("pipeline_chunk", 0.006, 0.0075, chunk=0,
+                engine="pipelined", estimated=True)
+    dd.child_at("pipeline_chunk", 0.0075, 0.009, chunk=1,
+                engine="pipelined", estimated=True)
     root.child_at("device_get", 0.009, 0.010)
     root.child_at("result_merge", 0.010, 0.011)
     root.finish(degraded=False)
@@ -217,7 +224,8 @@ class TestGoldenExports:
         assert root["name"] == "serve.request"
         kids = [e["name"] for e in events if e["tid"] == root["tid"]][1:]
         assert kids == ["cache_lookup", "queue_wait", "batch_assembly",
-                        "device_dispatch", "device_get", "result_merge"]
+                        "device_dispatch", "pipeline_chunk",
+                        "pipeline_chunk", "device_get", "result_merge"]
 
     def test_json_export_roundtrip(self):
         tracer = _golden_trace()
@@ -616,6 +624,44 @@ class TestServeTracing:
         sched.run_until_idle()
         assert t.done
         return tracer, sched, q
+
+    def test_pipeline_chunk_wave_spans(self, db, mesh4):
+        """A pipelined sharded searcher attaches one pipeline_chunk
+        child per chunk wave under the fenced device_dispatch span —
+        an even synthetic split of the measured device window, marked
+        estimated — plus the chunk-count attribute (ISSUE 14 obs
+        satellite); non-pipelined searchers attach none."""
+        from raft_tpu.parallel import sharded_ivf_flat_build
+
+        clock = _StepClock()
+        tracer = Tracer(clock=clock)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+        s = Searcher.ivf_flat(index, ivf_flat.SearchParams(n_probes=8),
+                              mesh=mesh4, merge_engine="pipelined")
+        q = np.random.default_rng(3).normal(
+            size=(8, DIM)).astype(np.float32)
+        root = tracer.request("serve.request")
+        s.search(q, 5, span=root)
+        root.finish()
+        dd = [c for c in root.children if c.name == "device_dispatch"][0]
+        waves = [c for c in dd.children if c.name == "pipeline_chunk"]
+        assert dd.attrs["pipeline_chunks"] == len(waves) == 2  # 8//4
+        assert [w.attrs["chunk"] for w in waves] == [0, 1]
+        assert all(w.attrs["estimated"] for w in waves)
+        assert waves[0].start == dd.start
+        assert waves[0].end == waves[1].start     # contiguous partition
+        assert waves[-1].end <= dd.end
+
+        s2 = Searcher.ivf_flat(index, ivf_flat.SearchParams(n_probes=8),
+                               mesh=mesh4, merge_engine="ring")
+        root2 = tracer.request("serve.request")
+        s2.search(q, 5, span=root2)
+        root2.finish()
+        dd2 = [c for c in root2.children
+               if c.name == "device_dispatch"][0]
+        assert not [c for c in dd2.children
+                    if c.name == "pipeline_chunk"]
 
     def test_complete_span_tree_per_request(self, db, mesh4):
         tracer, sched, _ = self._serve(db, mesh4)

@@ -138,6 +138,173 @@ class TestCompressedEngine:
         assert idx._scan_ops is None
 
 
+def _scan_harness(rng, n=4000, d=32, qn=64, n_lists=16, n_probes=8,
+                  pq_dim=8, is_ip=False):
+    """Build an index and the direct pq_fused_scan operand set (the
+    _compressed_scan_probes plumbing, minus the jit wrapper) so the
+    kernel's selection epilogues can be driven head-to-head."""
+    import jax.numpy as jnp
+    from raft_tpu.neighbors.ivf_pq import (_invert_probe_map_cells,
+                                           _select_clusters)
+
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    idx = ivf_pq.build(
+        ivf_pq.IndexParams(n_lists=n_lists, kmeans_n_iters=4,
+                           pq_dim=pq_dim), db)
+    codesT, lo, hi, invalid, crot_p = idx.compressed_scan_operands()
+    Q = db[:qn] + 0.05 * rng.normal(size=(qn, d)).astype(np.float32)
+    probe_ids = _select_clusters((jnp.asarray(Q), idx.centers), n_probes,
+                                 is_ip)
+    rotq = jnp.matmul(jnp.asarray(Q), idx.rotation_matrix.T)
+    rotq_p = permute_subspaces(rotq, idx.pq_dim, idx.pq_bits)
+    cell_list, bucket, _ = _invert_probe_map_cells(probe_ids, n_lists, 16)
+    Qc = rotq_p[jnp.maximum(bucket, 0)]
+    if not is_ip:
+        Qc = Qc - crot_p[jnp.maximum(cell_list, 0)][:, None, :]
+    return idx, cell_list, Qc, codesT, lo, hi, invalid
+
+
+class TestFusedSelectEpilogue:
+    """The streaming-select epilogue folded into the kernel (ISSUE 14 —
+    the _stream_select_min compress→rank→audit machinery in the scan)
+    must be BIT-IDENTICAL to the legacy k-pass sweep: same values, same
+    ids, same tie order, same starved sentinels — audit fallback
+    included."""
+
+    @pytest.mark.parametrize("k", [10, 16, 32, 100])
+    def test_fused_matches_legacy(self, rng, k):
+        from raft_tpu.ops.pq_scan import pq_fused_scan
+
+        _, cell_list, Qc, codesT, lo, hi, invalid = _scan_harness(rng)
+        d0, i0 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               k, 8, 8, False, True, fuse_select=0)
+        d1, i1 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               k, 8, 8, False, True, fuse_select=1)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_fused_matches_legacy_ip(self, rng):
+        from raft_tpu.ops.pq_scan import pq_fused_scan
+
+        _, cell_list, Qc, codesT, lo, hi, invalid = _scan_harness(
+            rng, is_ip=True)
+        d0, i0 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               20, 8, 8, True, True, fuse_select=0)
+        d1, i1 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               20, 8, 8, True, True, fuse_select=1)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+    def test_starved_lists_keep_sentinels(self, rng):
+        """Lists with fewer than k live slots: the fused epilogue must
+        emit the same +inf/-1 sentinel tails (no audit-fallback loop on
+        genuinely starved cells — the inf-worst rule)."""
+        import jax.numpy as jnp
+        from raft_tpu.ops.pq_scan import pq_fused_scan
+
+        _, cell_list, Qc, codesT, lo, hi, invalid = _scan_harness(
+            rng, n=600, n_lists=16)
+        # Tombstone-style masking of most slots exercises starvation.
+        invalid = jnp.asarray(np.asarray(invalid)
+                              | (np.arange(invalid.shape[1])[None, :] % 3
+                                 != 0))
+        d0, i0 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               32, 8, 8, False, True, fuse_select=0)
+        d1, i1 = pq_fused_scan(cell_list, Qc, codesT, lo, hi, invalid,
+                               32, 8, 8, False, True, fuse_select=1)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        assert (np.asarray(i1)[np.isinf(np.asarray(d1))] == -1).all()
+
+    def test_audit_fallback_is_exact(self, rng):
+        """Adversarial concentration: the whole top-k inside one
+        128-code tile (beyond the per-tile extract count) must trip the
+        audit and reproduce the legacy result exactly."""
+        import jax.numpy as jnp
+        from raft_tpu.neighbors.ivf_pq import pack_codes
+        from raft_tpu.ops.pq_scan import (_fused_extract_m, book_tables,
+                                          pq_fused_scan)
+
+        J, B, L, cap, k = 8, 256, 4, 512, 32
+        books = (rng.normal(size=(J, B, L)) * 0.01).astype(np.float32)
+        codes = rng.integers(1, B, size=(1, cap, J)).astype(np.int32)
+        codes[0, :128, :] = 0          # tile 0 = codeword-0 duplicates
+        packed = np.asarray(pack_codes(jnp.asarray(codes), 8)) \
+            .astype(np.uint8)
+        codesT = jnp.asarray(packed.transpose(0, 2, 1))
+        lo, hi = book_tables(jnp.asarray(books), 8)
+        invalid = jnp.zeros((1, cap), bool)
+        cw0 = books[:, 0, :].reshape(-1)
+        Qc = jnp.asarray(np.tile(cw0, (1, 8, 1)).astype(np.float32))
+        cl = jnp.asarray([0], jnp.int32)
+        assert _fused_extract_m(k, cap, -1) < k   # audit MUST trip
+        d0, i0 = pq_fused_scan(cl, Qc, codesT, lo, hi, invalid, k, J, 8,
+                               False, True, fuse_select=0)
+        d1, i1 = pq_fused_scan(cl, Qc, codesT, lo, hi, invalid, k, J, 8,
+                               False, True, fuse_select=1)
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        assert (np.asarray(i1)[0, 0] < 128).all()
+
+    def test_auto_gate(self):
+        from raft_tpu.ops.pq_scan import _FUSE_MAX_CAP, _fused_extract_m
+
+        assert _fused_extract_m(4, 2048, -1) == 0       # k below gate
+        assert _fused_extract_m(8, 2048, -1) > 0        # 1M-bench k class
+        assert _fused_extract_m(100, 2048, -1) > 0
+        assert _fused_extract_m(100, _FUSE_MAX_CAP * 2, -1) == 0
+        assert _fused_extract_m(100, 2048, 0) == 0       # forced legacy
+        assert _fused_extract_m(4, 2048, 1) > 0          # forced fused
+        m = _fused_extract_m(100, 2048, -1)
+        assert m % 8 == 0 and 2048 // 128 * m >= 100
+
+
+class TestInt8Lut:
+    """int8-quantized codeword tables (SearchParams.compressed_lut_int8
+    — ISSUE 14's LUT flag): bounded table error, bounded recall impact,
+    independent operand caches."""
+
+    def test_table_quantization_error_bound(self, rng):
+        J, B, L = 8, 256, 2
+        books = rng.normal(size=(J, B, L)).astype(np.float32)
+        lo, hi = (np.asarray(t) for t in book_tables(jnp.asarray(books),
+                                                     8))
+        lo8, hi8, scale = (np.asarray(t) for t in
+                           book_tables(jnp.asarray(books), 8, int8=True))
+        for qt, ft, col in ((lo8, lo, 0), (hi8, hi, 1)):
+            deq = qt.astype(np.float32) * scale[0, :, col][None, :, None]
+            amax = np.abs(ft).max(axis=2, keepdims=True)
+            assert np.all(np.abs(deq - ft) <= amax / 254 + 1e-7)
+
+    def test_search_recall_within_bound(self, rng):
+        n, d, qn, k = 4000, 32, 100, 10
+        db = rng.normal(size=(n, d)).astype(np.float32)
+        Q = db[:qn] + 0.05 * rng.normal(size=(qn, d)).astype(np.float32)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=16, kmeans_n_iters=4, pq_dim=8),
+            db)
+        base = ivf_pq.SearchParams(n_probes=16, engine="bucketed",
+                                   bucket_cap=qn)
+        i8 = ivf_pq.SearchParams(n_probes=16, engine="bucketed",
+                                 bucket_cap=qn, compressed_lut_int8=True)
+        _, bi = ivf_pq.search(base, idx, Q, k)
+        _, qi = ivf_pq.search(i8, idx, Q, k)
+        assert idx._recon is None            # compressed tier served both
+        assert _recall(qi, bi, k) >= 0.95    # documented recall bound
+        # both operand caches live independently
+        assert idx._scan_ops is not None and idx._scan_ops_i8 is not None
+
+    def test_extend_invalidates_both_caches(self, rng):
+        db = rng.normal(size=(1500, 16)).astype(np.float32)
+        idx = ivf_pq.build(
+            ivf_pq.IndexParams(n_lists=8, kmeans_n_iters=3, pq_dim=8), db)
+        idx.compressed_scan_operands()
+        idx.compressed_scan_operands(int8_lut=True)
+        assert idx._scan_ops is not None and idx._scan_ops_i8 is not None
+        idx = ivf_pq.extend(idx, db[:50])
+        assert idx._scan_ops is None and idx._scan_ops_i8 is None
+
+
 class TestPackUnpackProperty:
     """pack_codes/unpack_codes round-trip property at every pq_bits in
     the reference's supported range [4, 8] (ivf_pq_types.hpp:68), over

@@ -94,6 +94,35 @@ def test_sharded_ivf_flat_steady_state(mesh4, db, sanitizer_lane):
 
 
 @pytest.mark.sanitized
+def test_pipelined_engine_steady_state(mesh4, db, sanitizer_lane):
+    """The fused scan→merge pipeline (ISSUE 14) under the guard: the
+    chunked trace set pre-compiles behind BucketGrid.warmup and fresh
+    in-grid traffic serves with ZERO implicit transfers and ZERO
+    steady-state compiles, bit-identical to the unchunked engine."""
+    rng = np.random.default_rng(37)
+    with sanitizer_lane.allow_transfers():   # builds are not a hot path
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2)
+        index = sharded_ivf_flat_build(mesh4, params, db)
+    sp = ivf_flat.SearchParams(n_probes=8)
+    s_pipe = Searcher.ivf_flat(index, sp, mesh=mesh4,
+                               merge_engine="pipelined")
+    s_ref = Searcher.ivf_flat(index, sp, mesh=mesh4,
+                              merge_engine="allgather")
+    grid = BucketGrid(q_buckets=(8, 16), k_grid=(5,))
+    warmup(s_pipe, grid)
+    warmup(s_ref, grid)
+    sanitizer_lane.mark_steady()
+
+    for n in (8, 16, 8):
+        q = queries(rng, n)
+        res = s_pipe.search(q, 5)
+        ref = s_ref.search(q, 5)
+        np.testing.assert_array_equal(res.distances, ref.distances)
+        np.testing.assert_array_equal(res.indices, ref.indices)
+    assert sanitizer_lane.steady_compiles == 0
+
+
+@pytest.mark.sanitized
 def test_serve_scheduler_steady_state(mesh4, db, sanitizer_lane):
     """The full serving path — admission, micro-batching, padding,
     sharded search, cache write, result slicing — under the transfer

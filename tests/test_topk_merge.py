@@ -10,8 +10,9 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
 from raft_tpu.comms.topk_merge import (
-    MERGE_ENGINES, merge_comm_bytes, merge_parts, resolve_merge_engine,
-    topk_merge)
+    MERGE_ENGINES, merge_comm_bytes, merge_dispatch_stats, merge_parts,
+    pipeline_chunk_bounds, resolve_merge_engine, resolve_pipeline_chunks,
+    topk_merge, topk_merge_pipelined)
 from raft_tpu.util.shard_map_compat import shard_map
 
 
@@ -126,6 +127,241 @@ class TestEngineExactness:
         np.testing.assert_array_equal(base[1], ring[1])
 
 
+def _pipelined_on_mesh(mesh, dist, idx, k, select_min, n_chunks,
+                       quantized=False):
+    """dist/idx: (n_dev, q, kk); the chunk callback slices candidate
+    columns — the disjoint-chunk contract of topk_merge_pipelined."""
+    kk = dist.shape[2]
+    bounds = pipeline_chunk_bounds(kk, n_chunks)
+
+    def body(dd, ii):
+        def scan_chunk(c):
+            lo, hi = bounds[c]
+            return dd[0][:, lo:hi], ii[0][:, lo:hi]
+
+        return topk_merge_pipelined(scan_chunk, len(bounds), k, "data",
+                                    select_min=select_min,
+                                    quantized=quantized)
+
+    fn = shard_map(body, mesh=mesh, in_specs=(P("data"), P("data")),
+                   out_specs=(P(None, None), P(None, None)))
+    d, i = jax.jit(fn)(jnp.asarray(dist), jnp.asarray(idx))
+    return np.asarray(d), np.asarray(i)
+
+
+class TestPipelinedMerge:
+    """The fused scan→merge pipeline (ISSUE 14): per-chunk ring merges
+    folded under the shared total order must be BIT-IDENTICAL to the
+    unchunked engines over the concatenated candidates — on 1/2/4/8
+    devices (and the non-pow2 linear ring), for chunk counts that do
+    and do not divide the candidate width, with k above the per-chunk
+    width, and under mass distance ties."""
+
+    @pytest.mark.parametrize("n_dev", [1, 2, 3, 4, 8])
+    @pytest.mark.parametrize("q,kk,k,n_chunks", [
+        (4, 6, 5, 2),      # even-ish chunks
+        (3, 7, 10, 3),     # 7 columns into 3 chunks: 3/2/2 (odd split)
+        (5, 4, 16, 4),     # k > per-chunk candidates (and > kk)
+        (2, 9, 3, 5),      # more chunks than needed, tiny k
+    ])
+    @pytest.mark.parametrize("select_min", [True, False])
+    def test_matches_allgather(self, rng, n_dev, q, kk, k, n_chunks,
+                               select_min):
+        mesh = _mesh(n_dev)
+        dist = rng.normal(size=(n_dev, q, kk)).astype(np.float32)
+        idx = rng.permutation(n_dev * q * kk).astype(np.int32) \
+            .reshape(n_dev, q, kk)
+        base_d, base_i = _merge_on_mesh(mesh, dist, idx, k, select_min,
+                                        "allgather")
+        d, i = _pipelined_on_mesh(mesh, dist, idx, k, select_min,
+                                  n_chunks)
+        np.testing.assert_array_equal(base_d, d)
+        np.testing.assert_array_equal(base_i, i)
+
+    @pytest.mark.parametrize("n_dev", [2, 4, 8])
+    def test_ties_bit_identical(self, rng, n_dev):
+        """Mass integer-valued ties: the chunk folding must keep the
+        lowest-id total order exactly (associativity under ties)."""
+        mesh = _mesh(n_dev)
+        q, kk, k = 5, 8, 9
+        dist = rng.integers(0, 3, size=(n_dev, q, kk)).astype(np.float32)
+        idx = rng.permutation(n_dev * q * kk).astype(np.int32) \
+            .reshape(n_dev, q, kk)
+        base = _merge_on_mesh(mesh, dist, idx, k, True, "allgather")
+        for n_chunks in (2, 3):
+            d, i = _pipelined_on_mesh(mesh, dist, idx, k, True, n_chunks)
+            np.testing.assert_array_equal(base[0], d)
+            np.testing.assert_array_equal(base[1], i)
+
+    def test_quantized_chunks_rerank_exact_distances(self, rng):
+        """pipelined_bf16: per-chunk guard + exact re-rank — reported
+        distances are exact f32 and recall holds on well-separated
+        data (the per-chunk bound is weaker than unchunked ring_bf16)."""
+        mesh = _mesh(8)
+        q, kk, k = 16, 32, 10
+        dist = (rng.normal(size=(8, q, kk)) ** 2).astype(np.float32)
+        idx = rng.permutation(8 * q * kk).astype(np.int32) \
+            .reshape(8, q, kk)
+        base_d, base_i = _merge_on_mesh(mesh, dist, idx, k, True,
+                                        "allgather")
+        d, i = _pipelined_on_mesh(mesh, dist, idx, k, True, 4,
+                                  quantized=True)
+        recall = np.mean([len(np.intersect1d(i[r], base_i[r])) / k
+                          for r in range(q)])
+        assert recall == 1.0
+        np.testing.assert_array_equal(base_d, d)
+
+    def test_plain_topk_merge_degrades_pipelined_to_ring(self, rng):
+        """engine="pipelined" through the unchunked topk_merge API (one
+        candidate set, nothing to overlap) must equal the ring engine."""
+        mesh = _mesh(4)
+        dist = rng.normal(size=(4, 3, 6)).astype(np.float32)
+        idx = rng.permutation(72).astype(np.int32).reshape(4, 3, 6)
+        ring = _merge_on_mesh(mesh, dist, idx, 8, True, "ring")
+        pipe = _merge_on_mesh(mesh, dist, idx, 8, True, "pipelined")
+        np.testing.assert_array_equal(ring[0], pipe[0])
+        np.testing.assert_array_equal(ring[1], pipe[1])
+
+
+class TestShardedPipelinedConsumers:
+    """End-to-end sharded searches on the pipelined engines must match
+    the allgather engine bit-for-bit (float data — distance ties at the
+    per-shard truncation boundary resolve canonically by id on the
+    pipelined path, see docs/sharded_search.md)."""
+
+    @pytest.mark.parametrize("engine", ["pipelined", "pipelined_bf16"])
+    def test_sharded_knn_pipelined_agrees(self, rng, engine):
+        from raft_tpu.parallel import sharded_knn
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        q = rng.normal(size=(32, 16)).astype(np.float32)
+        bd, bi = sharded_knn(mesh, db, q, k=10, merge_engine="allgather")
+        d, i = sharded_knn(mesh, db, q, k=10, merge_engine=engine,
+                           pipeline_chunks=3)
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+
+    @pytest.mark.parametrize("tier", ["scan", "bucketed"])
+    @pytest.mark.parametrize("n_probes,chunks", [(7, 3), (8, 0), (5, 2)])
+    def test_sharded_ivf_flat_pipelined_grid(self, rng, tier, n_probes,
+                                             chunks):
+        """Odd n_probes not divisible by the chunk count, auto chunking,
+        both scan tiers — bit-identical to allgather."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(2048, 16)).astype(np.float32)
+        q = rng.normal(size=(24, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=16, kmeans_n_iters=4)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        sp = ivf_flat.SearchParams(n_probes=n_probes, engine=tier)
+        bd, bi = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                         merge_engine="allgather")
+        d, i = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                       merge_engine="pipelined",
+                                       pipeline_chunks=chunks)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+
+    def test_sharded_ivf_flat_k_exceeds_chunk_capacity(self, rng):
+        """k larger than any chunk's probed capacity: per-chunk widths
+        clamp and the fold still reproduces the unchunked result."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        mesh = _mesh(4)
+        db = rng.normal(size=(256, 8)).astype(np.float32)
+        q = rng.normal(size=(6, 8)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        sp = ivf_flat.SearchParams(n_probes=6, engine="scan")
+        bd, bi = sharded_ivf_flat_search(mesh, sp, sharded, q, 50,
+                                         merge_engine="allgather")
+        d, i = sharded_ivf_flat_search(mesh, sp, sharded, q, 50,
+                                       merge_engine="pipelined",
+                                       pipeline_chunks=3)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+
+    @pytest.mark.parametrize("tier", ["scan", "bucketed"])
+    def test_sharded_ivf_pq_pipelined_agrees(self, rng, tier):
+        """Both PQ tiers (LUT scan + compressed Pallas cells) through
+        the pipeline — bit-identical to allgather."""
+        from raft_tpu.neighbors import ivf_pq
+        from raft_tpu.parallel import (sharded_ivf_pq_build,
+                                       sharded_ivf_pq_search)
+
+        mesh = _mesh(8)
+        db = rng.normal(size=(2048, 32)).astype(np.float32)
+        q = rng.normal(size=(16, 32)).astype(np.float32)
+        params = ivf_pq.IndexParams(n_lists=16, pq_dim=16,
+                                    kmeans_n_iters=4)
+        sharded = sharded_ivf_pq_build(mesh, params, db)
+        sp = ivf_pq.SearchParams(n_probes=7, engine=tier)
+        bd, bi = sharded_ivf_pq_search(mesh, sp, sharded, q, 10,
+                                       merge_engine="allgather")
+        d, i = sharded_ivf_pq_search(mesh, sp, sharded, q, 10,
+                                     merge_engine="pipelined",
+                                     pipeline_chunks=3)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+
+    def test_degraded_live_mask_neutralizes_per_chunk(self, rng):
+        """A dead shard under the pipeline: every chunk neutralizes, the
+        result is exact over survivors and equals the unchunked degraded
+        path (coverage included)."""
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        mesh = _mesh(4)
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        q = rng.normal(size=(12, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        sp = ivf_flat.SearchParams(n_probes=6, engine="scan")
+        live = np.array([True, False, True, True])
+        bd, bi, bcov = sharded_ivf_flat_search(
+            mesh, sp, sharded, q, 10, merge_engine="allgather",
+            live_mask=live)
+        d, i, cov = sharded_ivf_flat_search(
+            mesh, sp, sharded, q, 10, merge_engine="pipelined",
+            pipeline_chunks=3, live_mask=live)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+        np.testing.assert_allclose(np.asarray(bcov), np.asarray(cov))
+
+    def test_tombstones_ride_the_pipeline(self, rng):
+        """Deleted rows (the traced tomb operand) stay masked in every
+        chunk — pipelined equals unchunked on the tombstoned index."""
+        from raft_tpu.lifecycle import delete
+        from raft_tpu.neighbors import ivf_flat
+        from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                       sharded_ivf_flat_search)
+
+        mesh = _mesh(4)
+        db = rng.normal(size=(512, 16)).astype(np.float32)
+        q = rng.normal(size=(8, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=3)
+        sharded = sharded_ivf_flat_build(mesh, params, db)
+        n = delete(sharded, np.arange(0, 512, 5), mesh=mesh)
+        assert n > 0
+        sp = ivf_flat.SearchParams(n_probes=8, engine="scan")
+        bd, bi = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                         merge_engine="allgather")
+        assert not np.intersect1d(np.asarray(bi),
+                                  np.arange(0, 512, 5)).size
+        d, i = sharded_ivf_flat_search(mesh, sp, sharded, q, 10,
+                                       merge_engine="pipelined",
+                                       pipeline_chunks=2)
+        np.testing.assert_array_equal(np.asarray(bi), np.asarray(i))
+        np.testing.assert_array_equal(np.asarray(bd), np.asarray(d))
+
+
 class TestResolveAndBytes:
     def test_resolve_rules(self):
         assert resolve_merge_engine("ring", 1, 1, 8) == "ring"
@@ -140,6 +376,71 @@ class TestResolveAndBytes:
             assert resolve_merge_engine("auto", q, k, n) != "ring_bf16"
         with pytest.raises(Exception):
             resolve_merge_engine("bogus", 1, 1, 2)
+
+    def test_pipelined_resolution_rules(self):
+        """auto picks pipelined only with a probe hint, n_probes >= 16,
+        n_dev >= 4 AND a merged volume clearing the small-merge floor;
+        never for plain merges; bf16 variants stay opt-in."""
+        assert resolve_merge_engine("auto", 1024, 100, 8,
+                                    n_probes=32) == "pipelined"
+        assert resolve_merge_engine("auto", 1024, 100, 4,
+                                    n_probes=16) == "pipelined"
+        assert resolve_merge_engine("auto", 1024, 100, 8,
+                                    n_probes=8) == "ring"
+        assert resolve_merge_engine("auto", 1024, 100, 2,
+                                    n_probes=64) == "allgather"
+        # tiny latency-bound merges keep the one-shot engines even with
+        # a chunkable producer (the _RING_MIN_WORK floor)
+        assert resolve_merge_engine("auto", 1, 10, 8,
+                                    n_probes=64) == "ring"
+        assert resolve_merge_engine("auto", 1, 10, 6,
+                                    n_probes=64) == "allgather"
+        assert resolve_merge_engine("auto", 1024, 100, 8) == "ring"
+        assert resolve_merge_engine("pipelined", 1, 1, 2) == "pipelined"
+        for q, k, n in ((1, 1, 4), (10_000, 256, 64)):
+            assert "bf16" not in resolve_merge_engine("auto", q, k, n,
+                                                      n_probes=64)
+
+    def test_pipeline_chunk_helpers(self):
+        assert resolve_pipeline_chunks("ring", 32, 8) == 1
+        assert resolve_pipeline_chunks("pipelined", 32, 1) == 1
+        assert resolve_pipeline_chunks("pipelined", 32, 8) == 4
+        assert resolve_pipeline_chunks("pipelined", 7, 8) == 1
+        assert resolve_pipeline_chunks("pipelined", 7, 8, requested=3) == 3
+        assert resolve_pipeline_chunks("pipelined", 2, 8,
+                                       requested=16) == 2
+        # bounds: contiguous, disjoint, cover [0, n), remainder leading
+        for n_items, n_chunks in ((7, 3), (8, 4), (5, 8), (1, 1)):
+            b = pipeline_chunk_bounds(n_items, n_chunks)
+            assert b[0][0] == 0 and b[-1][1] == n_items
+            assert all(b[i][1] == b[i + 1][0] for i in range(len(b) - 1))
+            assert all(hi > lo for lo, hi in b)
+
+    def test_pipelined_bytes_sum_per_chunk(self):
+        """One logical pipelined merge = N chunk ring exchanges: the
+        estimate sums the per-chunk volumes (more total bytes than one
+        unchunked ring — the price of the overlap) and the dispatch
+        recorder counts ONE dispatch, not N."""
+        ring = merge_comm_bytes("ring", 32, 10, 40, 8)
+        piped = merge_comm_bytes("pipelined", 32, 10, 40, 8,
+                                 chunk_kks=[10, 10, 10, 10])
+        assert piped == 4 * merge_comm_bytes("ring", 32, 10, 10, 8)
+        assert piped >= ring
+        # degenerate: no chunk info = one ring at full width
+        assert merge_comm_bytes("pipelined", 32, 10, 40, 8) == ring
+        assert merge_comm_bytes(
+            "pipelined_bf16", 32, 10, 40, 8, chunk_kks=[10, 10]) \
+            == 2 * merge_comm_bytes("ring_bf16", 32, 10, 10, 8)
+
+        merge_dispatch_stats.reset()
+        try:
+            merge_dispatch_stats.record("pipelined", 32, 10, 40, 8,
+                                        chunk_kks=[10, 10, 10, 10])
+            snap = merge_dispatch_stats.snapshot()
+            assert snap["pipelined"]["dispatches"] == 1
+            assert snap["pipelined"]["est_bytes"] == piped
+        finally:
+            merge_dispatch_stats.reset()
 
     def test_ring_bytes_below_allgather(self):
         """The acceptance bar: ring moves fewer bytes at n_dev >= 4. The
@@ -285,7 +586,8 @@ def test_bench_sharded_family_smoke(capsys):
     bench_sharded.run(quick=True)
     rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()
             if l.strip()]
-    by_engine = {r["engine"]: r for r in rows if "engine" in r}
+    by_engine = {r["engine"]: r for r in rows if "engine" in r
+                 and r["metric"] != "sharded_pipeline_ms"}
     assert {"allgather", "ring", "ring_bf16"} <= set(by_engine)
     for r in by_engine.values():
         assert r["value"] > 0
@@ -294,6 +596,19 @@ def test_bench_sharded_family_smoke(capsys):
     if n_dev >= 4:
         assert (by_engine["ring"]["est_exchange_bytes"]
                 < by_engine["allgather"]["est_exchange_bytes"])
+    # pipeline family (ISSUE 14): compute + per-engine total and
+    # exposed-comm rows, all engines incl. the pipelined pair.
+    pipe = [r for r in rows if r["metric"] == "sharded_pipeline_ms"]
+    phases = {(r["engine"], r["phase"]) for r in pipe}
+    assert ("local_scan", "compute") in phases
+    for eng in ("allgather", "ring", "ring_bf16", "pipelined",
+                "pipelined_bf16"):
+        assert (eng, "total") in phases and (eng, "exposed_comm") in phases
+    assert all(r["value"] >= 0 for r in pipe)
+    piped = [r for r in pipe if r["engine"] == "pipelined"
+             and r["phase"] == "total"]
+    if n_dev >= 4:
+        assert piped[0]["pipeline_chunks"] >= 2
 
 
 class TestKnnMergePartsEdgeCases:
