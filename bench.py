@@ -633,6 +633,16 @@ def _family_sharded():
     run(quick=False)
 
 
+def _family_routing():
+    """Probe-locality routing metrics (ISSUE 15): QPS, mean shard
+    fan-out, and estimated exchange bytes for placement="list" vs the
+    row-sharded baseline at uniform / clustered / hot query draws.
+    Body lives in bench/sharded.py (shared with the tier-1 smoke)."""
+    from bench.sharded import run_routing
+
+    run_routing(quick=False)
+
+
 def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
     rng = np.random.default_rng(seed)
     db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
@@ -731,6 +741,7 @@ def main():
     _run_family(_family_analyze, "bench_analyze_error")
     if "--no-1m" not in sys.argv:
         _run_family(_family_sharded, "bench_sharded_error")
+        _run_family(_family_routing, "bench_routing_error")
         _run_family(_family_serve, "bench_serve_error")
         _run_family(_family_obs, "bench_obs_error")
         _run_family(_family_lifecycle, "bench_lifecycle_error")

@@ -1,5 +1,6 @@
 """Sharded-search merge-engine bench family (ISSUE 1 bench satellite;
-ISSUE 14 adds the ``pipeline`` sub-family).
+ISSUE 14 adds the ``pipeline`` sub-family, ISSUE 15 the ``routing``
+family — :func:`run_routing`).
 
 Measures ``sharded_knn`` and sharded IVF-Flat search QPS per merge
 engine — allgather | ring | ring_bf16 | pipelined — over the full
@@ -147,7 +148,101 @@ def run(quick: bool = False) -> None:
               pipeline_chunks=n_chunks, est_exchange_bytes=est)
 
 
+def routing_workload(rng, n: int, d: int, nq: int, n_blobs: int = 16):
+    """Blob-structured db + three query draws at rising probe locality
+    (shared by :func:`run_routing` and the tier-1 routed bench test).
+    Real retrieval corpora are clustered — that structure is exactly
+    what the affinity-aware list placement converts into locality:
+    centroid-neighbor lists co-locate, so queries around few anchors
+    probe few shards.  Draws: ``low`` jitters around many anchors
+    (probes spread), ``medium`` around 4, ``high`` around 1 (a hot
+    working set)."""
+    blobs = rng.normal(size=(n_blobs, d)).astype(np.float32) * 6.0
+    lab = rng.integers(0, n_blobs, size=n)
+    db = (blobs[lab] + rng.normal(size=(n, d))).astype(np.float32)
+
+    def draw(n_anchors: int) -> np.ndarray:
+        anchors = db[rng.integers(0, n, size=n_anchors)]
+        picks = anchors[rng.integers(0, n_anchors, size=nq)]
+        return (picks + 0.05 * rng.normal(size=(nq, d))
+                ).astype(np.float32)
+
+    return db, (("low", draw(max(n_blobs, 16))), ("medium", draw(4)),
+                ("high", draw(1)))
+
+
+def run_routing(quick: bool = False) -> None:
+    """Routing bench family (ISSUE 15): ``placement="list"`` vs
+    ``placement="row"`` at low / medium / high probe locality
+    (:func:`routing_workload`).
+
+    Per (placement, locality) the family reports QPS, the mean shard
+    fan-out factor (shards participating per query — always n_dev for
+    the row placement), the batch participant count, and the estimated
+    per-device exchange bytes (``merge_comm_bytes``; routed dispatches
+    account participating shards only).  The routed exchange estimate
+    must sit strictly below the row baseline on the clustered draws,
+    with the gap growing as locality rises — the bench row the
+    acceptance gate reads."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.topk_merge import merge_comm_bytes
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (plan_route, sharded_ivf_flat_build,
+                                   sharded_ivf_flat_search)
+    from raft_tpu.parallel.ivf import _routed_probe_flat
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    n_dev = devs.size
+    rng = np.random.default_rng(5)
+
+    if quick:
+        n, d, nq, k, reps, rounds = 4096, 16, 64, 10, 2, 2
+        n_lists, n_probes = 32, 4
+    else:
+        n, d, nq, k, reps, rounds = 262_144, 64, 1024, 100, 8, 5
+        n_lists, n_probes = 256, 16
+    n -= n % n_dev
+
+    db_h, draws = routing_workload(rng, n, d, nq)
+    db = jnp.asarray(db_h)
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=8)
+    row = sharded_ivf_flat_build(mesh, params, db)
+    lst = sharded_ivf_flat_build(mesh, params, db, centers=row.centers,
+                                 placement="list")
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+
+    cap_row = int(row.indices.shape[1] * row.indices.shape[2])
+    cap_list = int(lst.indices.shape[2])
+    for locality, q_h in draws:
+        q = jnp.asarray(q_h)
+        probe_h = np.asarray(jax.device_get(_routed_probe_flat(
+            q, lst.centers, n_probes=min(n_probes, n_lists),
+            inner_is_l2=True)))
+        plan = plan_route(probe_h, lst.placement_map)
+        for placement, index in (("row", row), ("list", lst)):
+            qps = _qps(lambda qq, i=index: sharded_ivf_flat_search(
+                mesh, sp, i, qq, k), q, reps, rounds)
+            if placement == "row":
+                fanout, participants = n_dev, n_dev
+                est = merge_comm_bytes("auto", nq, k, min(k, cap_row),
+                                       n_dev)
+            else:
+                fanout, participants = plan.fanout_mean, plan.participants
+                est = merge_comm_bytes(
+                    "auto", nq, k, min(k, plan.pb * cap_list), n_dev,
+                    participants=plan.participants)
+            _emit("sharded_routed_qps", qps, "qps", placement=placement,
+                  locality=locality, mesh_devices=n_dev, n_db=n, dim=d,
+                  k=k, n_probes=n_probes, fanout_mean=round(fanout, 3),
+                  participants=participants, est_exchange_bytes=est)
+
+
 if __name__ == "__main__":
     import sys
 
     run(quick="--quick" in sys.argv)
+    run_routing(quick="--quick" in sys.argv)

@@ -131,7 +131,16 @@ class ShardHealth:
     @property
     def live_mask(self) -> np.ndarray:
         """Copy of the per-rank liveness mask (bool (n_ranks,)) — the
-        ``live_mask`` operand of the sharded search entry points."""
+        ``live_mask`` operand of the sharded search entry points.
+
+        Row-sharded searches consume it as a collective-side operand
+        (dead shards' candidates neutralize to merge sentinels);
+        ``placement="list"`` routed searches consume it as a ROUTING
+        input (parallel/routing.plan_route): dead shards receive no
+        queries, hot-list replicas are selected by liveness (a dead
+        primary serves through its live replica), and lists with no
+        live owner surface as per-query coverage loss — see
+        docs/sharded_search.md §placement."""
         with self._lock:
             return self._live.copy()
 

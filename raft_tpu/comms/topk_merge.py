@@ -77,6 +77,7 @@ from jax import lax
 from raft_tpu.core.error import expects
 from raft_tpu.core.sentinels import worst_value
 from raft_tpu.util.pow2 import is_pow2
+from raft_tpu.util.telemetry import SuppressibleStats
 from raft_tpu.util.shard_map_compat import axis_size as _axis_size
 
 MERGE_ENGINES = ("auto", "allgather", "ring", "ring_bf16", "pipelined",
@@ -192,7 +193,8 @@ def pipeline_chunk_bounds(n_items: int, n_chunks: int):
 
 def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
                      n_dev: int, idx_bytes: int = 4,
-                     chunk_kks: Optional[Sequence[int]] = None) -> int:
+                     chunk_kks: Optional[Sequence[int]] = None,
+                     participants: Optional[int] = None) -> int:
     """Estimated collective bytes RECEIVED per device for one merge.
 
     ``kk`` is the per-device candidate width (min(k, shard capacity)).
@@ -209,7 +211,28 @@ def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
     exchange behind the remaining chunks' scans. Without it the
     pipelined engines estimate as one ring at width ``kk`` (the
     degenerate single-chunk case).
+
+    ``participants`` accounts a ROUTED dispatch (ISSUE 15): only that
+    many shards contribute real candidates — the rest carry merge
+    sentinels — so the estimate is the volume of the same merge over
+    ``participants`` devices (0/1 participants → no meaningful exchange
+    → 0 bytes), CAPPED at the full-mesh volume: a routed merge can
+    always run the full collective with sentinel payloads, so a
+    partial-participant topology that would move more (a 5-of-8 linear
+    ring vs the 8-way butterfly) never charges more than the engine the
+    dispatcher actually has.  Still ONE logical merge; the routed entry
+    points pass their plan's participant count so the scraped exchange
+    volume tracks probe locality instead of mesh size.
     """
+    if participants is not None:
+        p = min(n_dev, max(int(participants), 1))
+        full = merge_comm_bytes(engine, n_queries, k, kk, n_dev,
+                                idx_bytes, chunk_kks=chunk_kks)
+        if p >= n_dev:
+            return full
+        return min(full, merge_comm_bytes(engine, n_queries, k, kk, p,
+                                          idx_bytes,
+                                          chunk_kks=chunk_kks))
     engine = resolve_merge_engine(engine, n_queries, k, n_dev)
     if n_dev <= 1:
         return 0
@@ -237,7 +260,7 @@ def merge_comm_bytes(engine: str, n_queries: int, k: int, kk: int,
     return total
 
 
-class MergeDispatchStats:
+class MergeDispatchStats(SuppressibleStats):
     """Host-side per-engine dispatch accounting for the scrape surface.
 
     The sharded search entry points (parallel/knn.py, parallel/ivf.py)
@@ -248,46 +271,35 @@ class MergeDispatchStats:
     dict updates per sharded call, nothing near the device.  Counts are
     host dispatches: a caller that wraps an entry point in its own
     ``jax.jit``/``lax.scan`` records once per trace, not per replay
-    (same caveat as any host-side counter under tracing).
+    (same caveat as any host-side counter under tracing).  ``suppress``
+    (util/telemetry.py) drops a thread's shadow traffic — the recall
+    probe's exact scans dispatch through the same entry points.
     """
 
     def __init__(self):
+        super().__init__()
         self._lock = threading.Lock()
         self._dispatches: Dict[str, int] = {}
         self._bytes: Dict[str, int] = {}
-        self._local = threading.local()
-
-    def suppress(self):
-        """Context manager: drop this THREAD's records while active —
-        the recall probe's shadow exact-scans dispatch through the same
-        sharded entry points, and counting them would inflate the
-        serving exchange-volume metrics with non-serving traffic."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def _ctx():
-            prev = getattr(self._local, "off", False)
-            self._local.off = True
-            try:
-                yield
-            finally:
-                self._local.off = prev
-
-        return _ctx()
 
     def record(self, engine: str, n_queries: int, k: int, kk: int,
                n_dev: int, idx_bytes: int = 4,
-               chunk_kks: Optional[Sequence[int]] = None) -> None:
+               chunk_kks: Optional[Sequence[int]] = None,
+               participants: Optional[int] = None) -> None:
         """One LOGICAL merge dispatch. ``chunk_kks`` marks a chunked
         (pipelined) dispatch: the byte estimate sums the N per-chunk
         exchanges but the dispatch still counts ONCE — the scrape
         reports logical merges per search call, and counting every
         chunk exchange as a dispatch would inflate the per-query
-        exchange-byte ratio N-fold after the pipeline lands."""
-        if getattr(self._local, "off", False):
+        exchange-byte ratio N-fold after the pipeline lands.
+        ``participants`` marks a routed (partial-shard) dispatch: the
+        byte estimate covers the participating shards only, still as
+        one logical merge (see :func:`merge_comm_bytes`)."""
+        if self._suppressed():
             return
         est = merge_comm_bytes(engine, n_queries, k, kk, n_dev, idx_bytes,
-                               chunk_kks=chunk_kks)
+                               chunk_kks=chunk_kks,
+                               participants=participants)
         with self._lock:
             self._dispatches[engine] = self._dispatches.get(engine, 0) + 1
             self._bytes[engine] = self._bytes.get(engine, 0) + est

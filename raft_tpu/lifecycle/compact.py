@@ -72,7 +72,14 @@ class CompactionPolicy:
     above this multiple of the mean (None = off).  ``drift_threshold``
     — IVF-Flat only: recluster lists whose center sits further than
     this multiple of the median nearest-center gap from their
-    live-member mean (None = off).
+    live-member mean (None = off).  ``balance_placement`` —
+    ``placement="list"`` sharded indexes only: when the hottest shard's
+    probe load exceeds this multiple of the mean shard load (observed
+    per-list probe traffic from ``parallel.routing.routing_stats``,
+    falling back to stored row counts before any traffic), the pass
+    migrates lists to a re-balanced owner assignment
+    (``sharded_migrate_lists``) — the compactor doubling as the routed
+    placement's load balancer (None = off).
     """
 
     trigger_frac: float = 0.25
@@ -80,6 +87,7 @@ class CompactionPolicy:
     split_above: Optional[float] = None
     drift_threshold: Optional[float] = None
     min_split_rows: int = 16
+    balance_placement: Optional[float] = None
 
     def __post_init__(self):
         expects(0.0 < self.trigger_frac <= 1.0,
@@ -88,6 +96,10 @@ class CompactionPolicy:
                 "split_above must be > 1 (a multiple of the mean load)")
         expects(self.drift_threshold is None or self.drift_threshold > 0,
                 "drift_threshold must be > 0")
+        expects(self.balance_placement is None
+                or self.balance_placement >= 1.0,
+                "balance_placement must be >= 1 (a multiple of the "
+                "mean shard load)")
 
 
 @dataclass(frozen=True)
@@ -103,6 +115,8 @@ class CompactionReport:
     cap_before: int
     cap_after: int
     epoch: int            # the successor index's epoch
+    # placement="list" balancer outcome (sharded routed indexes only).
+    lists_migrated: int = 0
 
 
 def _repack(flat_rows, labels, flat_ids, n_lists: int, min_cap: int):
@@ -323,24 +337,119 @@ def _warn_model_pass(policy: CompactionPolicy, what: str) -> None:
             "lists without re-encoding) — ignored for %s", what)
 
 
-def compact(index, policy: Optional[CompactionPolicy] = None, mesh=None):
+def _n_lists_of(index) -> int:
+    """Logical list count for the report: list-placement tensors are
+    shaped by per-shard SLOTS (pow2, incl. padding/replica slots) —
+    reporting those as n_lists would show the count 'changing' on
+    every rebalance."""
+    pm = getattr(index, "placement_map", None)
+    if pm is not None:
+        return pm.n_lists
+    return int(index.indices.shape[-2])
+
+
+def _balance_weights(index) -> Optional[np.ndarray]:
+    """Per-list migration weights for the placement balancer: THIS
+    placement generation's observed probe loads when the router has
+    seen traffic, else the stored row counts (the build-time packing
+    criterion)."""
+    from raft_tpu.parallel.ivf import _routed_sizes_h
+    from raft_tpu.parallel.routing import routing_stats
+
+    loads = routing_stats.list_loads(
+        index.placement_map).astype(np.float64)
+    if loads.sum() == 0:
+        loads = _routed_sizes_h(index).astype(np.float64)
+    return loads
+
+
+def _owner_imbalance(owner, loads, n_dev: int) -> float:
+    """Hottest shard's load as a multiple of the mean shard load under
+    a (possibly hypothetical) owner assignment."""
+    shard = np.zeros(n_dev, np.float64)
+    np.add.at(shard, owner, np.asarray(loads, np.float64))
+    mean = float(shard.mean())
+    return float(shard.max()) / mean if mean > 0 else 1.0
+
+
+def _placement_imbalance(index, loads) -> float:
+    pm = index.placement_map
+    return _owner_imbalance(pm.owner, loads, pm.n_dev)
+
+
+def compact(index, policy: Optional[CompactionPolicy] = None, mesh=None,
+            live_mask=None):
     """Run one compaction pass; returns ``(new_index, report)`` — a
     copy-on-write successor at ``epoch + 1`` — or ``(index, None)`` when
     there is nothing to do (no tombstones and no model pass requested).
     The input index is NEVER mutated: callers publish by swapping the
     reference (``Searcher.compact`` does, atomically under its mutation
-    lock), so a pass that raises publishes nothing."""
+    lock), so a pass that raises publishes nothing.
+
+    For ``placement="list"`` sharded indexes a pass with
+    ``balance_placement`` set doubles as the routed load balancer:
+    when the observed probe traffic (``routing_stats``) leaves the
+    hottest shard past the trigger multiple of the mean, the pass
+    migrates lists to a re-balanced owner assignment (replicated lists
+    keep a second live copy) — published by the SAME single COW
+    snapshot swap (one epoch bump), so routed results are bit-identical
+    across the re-balance.  ``live_mask`` (``ShardHealth.live_mask``,
+    passed by ``Searcher.compact``) gates the balancer: while any
+    shard is dead the re-balance is DEFERRED — assigning lists onto an
+    unreachable shard would turn a load fix into coverage loss."""
     policy = policy or CompactionPolicy()
     _check_index(index, mesh)
     wants_model = (policy.split_above is not None
                    or policy.drift_threshold is not None)
-    if index.n_deleted == 0 and not wants_model and not policy.shrink_capacity:
+    bal_loads = None
+    if (policy.balance_placement is not None and _is_sharded(index)
+            and getattr(index, "placement", "row") == "list"):
+        if live_mask is not None and not np.asarray(live_mask).all():
+            logger.trace("placement balance deferred: %s dead shard(s) "
+                         "— migrating onto a dead shard would trade "
+                         "load for coverage",
+                         int((~np.asarray(live_mask)).sum()))
+        else:
+            from raft_tpu.parallel.routing import assign_lists
+
+            loads = _balance_weights(index)
+            cur = _placement_imbalance(index, loads)
+            if cur >= policy.balance_placement:
+                # Improvement guard: only migrate when the fresh
+                # assignment actually lowers the imbalance — without
+                # it a skewed load the bisection cannot balance below
+                # the trigger would re-migrate every daemon tick.
+                cand = assign_lists(
+                    loads, index.placement_map.n_dev,
+                    centers=np.asarray(jax.device_get(index.centers)))
+                if _owner_imbalance(cand, loads,
+                                    index.placement_map.n_dev) < cur:
+                    bal_loads, bal_owner = loads, cand
+    if (index.n_deleted == 0 and not wants_model
+            and not policy.shrink_capacity and bal_loads is None):
         return index, None
     reclaimed = index.n_deleted
-    n_split = n_recl = 0
+    n_split = n_recl = n_migrated = 0
     if _is_sharded(index):
-        new, cap, new_cap = _compact_sharded(mesh, index, policy)
-        n_lists_after = new.indices.shape[1]
+        if (bal_loads is not None and index.n_deleted == 0
+                and not policy.shrink_capacity):
+            # Balance-only pass: nothing to reclaim — the per-shard
+            # repack would rebuild identical tensors just for the
+            # migration below to rewrite them a second time.
+            new, cap = index, index.indices.shape[-1]
+            new_cap = cap
+        else:
+            new, cap, new_cap = _compact_sharded(mesh, index, policy)
+        n_lists_after = _n_lists_of(new)
+        if bal_loads is not None:
+            from raft_tpu.parallel.ivf import sharded_migrate_lists
+
+            new, n_migrated = sharded_migrate_lists(
+                mesh, new, bal_owner, live_mask=live_mask)
+            # ONE published epoch bump for the whole pass — the
+            # reclaim+migrate intermediate was never visible.
+            new = dataclasses.replace(new, epoch=index.epoch + 1)
+            n_lists_after = _n_lists_of(new)
     elif isinstance(index, _pq.Index):
         new, cap, new_cap = _compact_pq(index, policy)
         n_lists_after = new.n_lists
@@ -349,14 +458,19 @@ def compact(index, policy: Optional[CompactionPolicy] = None, mesh=None):
         n_lists_after = new.n_lists
     report = CompactionReport(
         reclaimed_slots=reclaimed,
-        live_rows=int(jnp.sum(new.list_sizes)),
+        # Primary copies only for replicated list placements — the
+        # same convention as size / n_deleted / tombstone_frac.
+        live_rows=(new.size
+                   if getattr(new, "placement_map", None) is not None
+                   else int(jnp.sum(new.list_sizes))),
         lists_split=n_split,
         lists_reclustered=n_recl,
-        n_lists_before=index.indices.shape[-2],
+        n_lists_before=_n_lists_of(index),
         n_lists_after=n_lists_after,
         cap_before=cap,
         cap_after=new_cap,
         epoch=new.epoch,
+        lists_migrated=n_migrated,
     )
     return new, report
 
@@ -396,6 +510,12 @@ class Compactor:
         # must clear and re-trip to force another).
         self._drift_signal = drift_signal
         self._drift_armed = True
+        # balance_placement is edge-triggered like drift: one fired
+        # evaluation per imbalance episode.  A non-improvable or
+        # dead-shard-deferred imbalance would otherwise keep should_run
+        # hot and re-run the full (futile) balance evaluation every
+        # tick; the trigger re-arms only when the imbalance clears.
+        self._balance_armed = True
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.passes = 0
@@ -413,10 +533,15 @@ class Compactor:
         self.last_trigger_frac = 0.0
 
     def should_run(self) -> bool:
-        """Tombstone fraction at or past the policy trigger, or the
-        query-aware ``drift_signal`` tripped.  Records the evaluation
-        (``last_should_run`` / ``last_trigger_frac``) so the metrics
-        scrape reads host state instead of re-deriving device sums."""
+        """Tombstone fraction at or past the policy trigger, the
+        query-aware ``drift_signal`` tripped, or (``balance_placement``
+        policies over a routed index) the observed probe load past the
+        imbalance trigger — without the last clause a balance-only
+        policy would never fire from the daemon loop, since an
+        imbalanced placement produces no tombstones.  Records the
+        evaluation (``last_should_run`` / ``last_trigger_frac``) so the
+        metrics scrape reads host state instead of re-deriving device
+        sums."""
         from raft_tpu.lifecycle.delete import tombstone_frac
 
         index = getattr(self.searcher, "_index", None)
@@ -428,12 +553,32 @@ class Compactor:
         if not raw_drift:
             self._drift_armed = True        # episode over: re-arm
         drifted = raw_drift and self._drift_armed
+        raw_imbal = False
+        if (self.policy.balance_placement is not None
+                and getattr(index, "placement", "row") == "list"):
+            health = getattr(self.searcher, "health", None)
+            if health is not None and not health.all_live():
+                # compact() would defer the migration anyway (a
+                # re-balance must not assign onto a dead shard); not
+                # firing here keeps the edge ARMED so the rebalance
+                # happens when the shard recovers, instead of the
+                # deferral consuming the one fire per episode.
+                raw_imbal = False
+            else:
+                raw_imbal = (_placement_imbalance(
+                    index, _balance_weights(index))
+                    >= self.policy.balance_placement)
+        if not raw_imbal:
+            self._balance_armed = True      # episode over: re-arm
+        imbalanced = raw_imbal and self._balance_armed
         self.last_trigger_frac = frac
         self.last_should_run = (index is not None
-                                and (drifted
+                                and (drifted or imbalanced
                                      or frac >= self.policy.trigger_frac))
         if self.last_should_run and drifted:
             self._drift_armed = False       # one forced pass per episode
+        if self.last_should_run and imbalanced:
+            self._balance_armed = False     # one evaluation per episode
         return self.last_should_run
 
     def run_once(self, force: bool = False) -> Optional[CompactionReport]:

@@ -64,12 +64,16 @@ def _check_index(index, mesh) -> None:
 
 
 @jax.jit
-def _tombstone(indices, list_sizes, deleted, del_ids):
+def _tombstone(indices, list_sizes, deleted, del_ids, primary=None):
     """Membership-mark pass: slots whose id is in ``del_ids`` AND below
     their list's fill line become tombstones.  Pure (copy-on-write —
     arrays read off the index before the delete stay valid); shapes in
     == shapes out, so repeat deletes reuse one compiled program.
-    Returns ``(new_mask, newly_deleted_count)``."""
+    ``primary`` (replicated list-placement indexes only,
+    ``parallel.ivf.routed_primary_mask``) restricts the COUNT to
+    primary copies — a row deleted from a replicated list masks both
+    copies but is one logical deletion.  Returns ``(new_mask,
+    newly_deleted_count)``."""
     sorted_ids = jnp.sort(del_ids)
     pos = jnp.searchsorted(sorted_ids, indices)
     pos = jnp.minimum(pos, sorted_ids.shape[0] - 1)
@@ -77,7 +81,8 @@ def _tombstone(indices, list_sizes, deleted, del_ids):
     slot = jnp.arange(indices.shape[-1], dtype=jnp.int32)
     valid = slot < list_sizes[..., None]
     newly = hit & valid & ~deleted
-    return deleted | newly, jnp.sum(newly)
+    counted = newly if primary is None else newly & primary[..., None]
+    return deleted | newly, jnp.sum(counted)
 
 
 def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
@@ -98,6 +103,16 @@ def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
         return jax.device_put(jnp.asarray(padded),
                               NamedSharding(mesh, P()))
     return jnp.asarray(padded)
+
+
+def _primary_mask(index, mesh):
+    """Primary-copy count mask for replicated list placements (None
+    otherwise — the common trace stays unchanged)."""
+    if getattr(index, "placement_map", None) is None:
+        return None
+    from raft_tpu.parallel.ivf import routed_primary_mask
+
+    return routed_primary_mask(mesh, index)
 
 
 def _blank_mask(index, mesh) -> jax.Array:
@@ -141,8 +156,16 @@ def tombstone_frac(index) -> float:
     trigger statistic (:class:`~raft_tpu.lifecycle.compact.Compactor`).
     The one device scalar is pulled via an EXPLICIT ``jax.device_get``:
     metrics collectors call this from scraper threads, which must stay
-    legal under the sanitizer lane's ``transfer_guard("disallow")``."""
-    size = int(jax.device_get(jnp.sum(index.list_sizes)))
+    legal under the sanitizer lane's ``transfer_guard("disallow")``.
+    List-placement indexes count primary copies only on BOTH sides of
+    the ratio (``n_deleted`` follows the same convention), so replicas
+    never skew the trigger."""
+    if getattr(index, "placement_map", None) is not None:
+        from raft_tpu.parallel.ivf import _routed_sizes_h
+
+        size = int(_routed_sizes_h(index).sum())
+    else:
+        size = int(jax.device_get(jnp.sum(index.list_sizes)))
     return index.n_deleted / size if size else 0.0
 
 
@@ -161,7 +184,7 @@ def delete(index, ids, mesh=None) -> int:
     mask = index.deleted if index.deleted is not None \
         else _blank_mask(index, mesh)
     new_mask, cnt = _tombstone(index.indices, index.list_sizes, mask,
-                               del_ids)
+                               del_ids, _primary_mask(index, mesh))
     n = int(jax.device_get(cnt))
     if n == 0:
         # Nothing matched: no mask attach, no bump — a no-op must not
@@ -204,7 +227,10 @@ def upsert(index, new_vectors, new_indices, mesh=None, *,
             index.centers.shape[1])
     expects(np.unique(ids).size == ids.size,
             "upsert ids must be unique within the batch")
-    if _is_sharded(index):
+    if _is_sharded(index) and getattr(index, "placement", "row") == "row":
+        # placement="list" deals rows by list OWNERSHIP (arbitrary
+        # counts); only the contiguous row-sharded deal needs the
+        # divisibility contract.
         n_dev = mesh.shape[index.axis]
         expects(X.shape[0] % n_dev == 0,
                 "sharded upsert rows (%s) must divide the mesh axis "
@@ -215,7 +241,7 @@ def upsert(index, new_vectors, new_indices, mesh=None, *,
     mask = index.deleted if index.deleted is not None \
         else _blank_mask(index, mesh)
     new_mask, cnt = _tombstone(index.indices, index.list_sizes, mask,
-                               del_ids)
+                               del_ids, _primary_mask(index, mesh))
     # The extend below carries the upsert's single epoch bump — bumping
     # here too would invalidate caches twice and expose the tombstone-
     # only half state as a committed epoch.

@@ -33,6 +33,7 @@ from raft_tpu.obs.registry import (
     Histogram,
     MergeDispatchCollector,
     MetricsRegistry,
+    RoutingCollector,
     SearcherCollector,
     ServeStatsCollector,
     ShardHealthCollector,
@@ -44,5 +45,5 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
-    "RecallProbe",
+    "RoutingCollector", "RecallProbe",
 ]

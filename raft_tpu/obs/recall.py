@@ -175,15 +175,17 @@ class RecallProbe:
         top-k, computed at the request's serving bucket shape (the
         closed compiled set — steady-state probing retraces nothing)."""
         from raft_tpu.comms.topk_merge import merge_dispatch_stats
+        from raft_tpu.parallel.routing import routing_stats
         from raft_tpu.serve.bucketing import pad_queries
 
         qb, kb = bucket
         rows = queries.shape[0]
         padded = pad_queries(queries, qb) if rows < qb else queries
         # Shadow scans must not count as serving traffic on the
-        # raft_merge_* scrape (they dispatch through the same sharded
-        # entry points the MergeDispatchCollector meters).
-        with merge_dispatch_stats.suppress():
+        # raft_merge_* / raft_route_* scrapes (they dispatch through
+        # the same sharded entry points the collectors meter — and the
+        # routed probe-load gauges feed the placement balancer).
+        with merge_dispatch_stats.suppress(), routing_stats.suppress():
             truth = np.asarray(self._truth(padded, kb))[:rows, :k]
         served = np.asarray(indices)[:, :k]
         # PAD_ID (-1) fills short answers (k > live candidates); a

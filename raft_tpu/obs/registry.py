@@ -39,6 +39,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
+    "RoutingCollector",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -443,8 +444,8 @@ class CompactorCollector:
     here it is a counter plus the failure repr as an info label."""
 
     _REPORT_FIELDS = ("reclaimed_slots", "live_rows", "lists_split",
-                      "lists_reclustered", "n_lists_after", "cap_after",
-                      "epoch")
+                      "lists_reclustered", "lists_migrated",
+                      "n_lists_after", "cap_after", "epoch")
 
     def __init__(self, registry: MetricsRegistry, compactor,
                  prefix: str = "raft_compactor"):
@@ -544,6 +545,59 @@ class MergeDispatchCollector:
         for engine, row in snap.items():
             self._dispatches.set_total(row["dispatches"], engine=engine)
             self._bytes.set_total(row["est_bytes"], engine=engine)
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class RoutingCollector:
+    """Routed-placement telemetry (parallel/routing.py
+    ``routing_stats``): per-shard probe-load and routed-query counters,
+    lists owned, replica hits, and the mean routing fan-out — the
+    gauges that make the placement balancer's effect scrapeable
+    (queries spread across shards, hot-list replica reads, fan-out
+    dropping as locality rises)."""
+
+    def __init__(self, registry: MetricsRegistry, stats=None,
+                 prefix: str = "raft_route"):
+        if stats is None:
+            from raft_tpu.parallel.routing import routing_stats
+            stats = routing_stats
+        self.stats = stats
+        self._dispatches = registry.counter(
+            prefix + "_dispatch_total", "routed search dispatches")
+        self._queries = registry.counter(
+            prefix + "_queries_total", "queries routed (all shards)")
+        self._shard_queries = registry.counter(
+            prefix + "_shard_queries_total",
+            "queries routed per shard", labels=("shard",))
+        self._shard_probes = registry.counter(
+            prefix + "_shard_probe_load_total",
+            "probed (query, list) occurrences per shard",
+            labels=("shard",))
+        self._lists_owned = registry.gauge(
+            prefix + "_lists_owned", "primary lists owned per shard",
+            labels=("shard",))
+        self._replica_hits = registry.counter(
+            prefix + "_replica_hits_total",
+            "probe occurrences served by a hot-list replica")
+        self._fanout = registry.gauge(
+            prefix + "_fanout_mean",
+            "mean shards participating per query (lifetime)")
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        snap = self.stats.snapshot()
+        self._dispatches.set_total(snap["dispatches"])
+        self._queries.set_total(snap["queries"])
+        self._replica_hits.set_total(snap["replica_hits"])
+        self._fanout.set(snap["fanout_mean"])
+        for s, n in snap["shard_queries"].items():
+            self._shard_queries.set_total(n, shard=s)
+        for s, n in snap["shard_probes"].items():
+            self._shard_probes.set_total(n, shard=s)
+        for s, n in snap["lists_owned"].items():
+            self._lists_owned.set(n, shard=s)
 
     def close(self) -> None:
         self._unsub()

@@ -24,6 +24,19 @@ from raft_tpu.parallel.ivf import (
     sharded_ivf_pq_extend,
     sharded_ivf_pq_search,
     sharded_ivf_save,
+    sharded_migrate_lists,
+    sharded_replicate_lists,
+    sharded_routed_warmup,
+)
+from raft_tpu.parallel.routing import (
+    ListPlacement,
+    RoutePlan,
+    RoutingStats,
+    assign_lists,
+    build_placement,
+    plan_route,
+    route_shapes,
+    routing_stats,
 )
 
 __all__ = [
@@ -35,4 +48,8 @@ __all__ = [
     "sharded_ivf_pq_build", "sharded_ivf_pq_search",
     "sharded_ivf_flat_extend", "sharded_ivf_pq_extend",
     "sharded_ivf_save", "sharded_ivf_load",
+    "sharded_migrate_lists", "sharded_replicate_lists",
+    "sharded_routed_warmup",
+    "ListPlacement", "RoutePlan", "RoutingStats", "assign_lists",
+    "build_placement", "plan_route", "route_shapes", "routing_stats",
 ]

@@ -142,7 +142,17 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
     future mask value. Returns a report dict: shapes warmed, actual XLA
     compile events observed (second boot on a machine reports ~0 — the
     persistent cache served them), and the cache directory.
-    """
+
+    ``placement="list"`` (routed) searchers warm MORE than the grid
+    shapes: a routed dispatch's program is keyed by the plan's pow2
+    (query-group, local-probe-width) buckets, so each (q_bucket, k)
+    shape additionally pre-compiles the closed routed ladder
+    (``parallel.routing.route_shapes``) via
+    :func:`~raft_tpu.parallel.ivf.sharded_routed_warmup` — steady-state
+    routed traffic then never compiles regardless of how queries
+    cluster.  The routed program is liveness-FREE (liveness is a
+    routing input, not an operand), so ``include_degraded`` adds no
+    extra routed traces."""
     from raft_tpu.core.compilation_cache import enable_compilation_cache
     from raft_tpu.core.logger import logger
     from raft_tpu.serve.stats import CompileCounter
@@ -156,7 +166,27 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
     effective_dir = enable_compilation_cache(cache_dir)
     dim = searcher.dim
     shapes = grid.shapes()
-    with CompileCounter() as counter:
+    routed = (getattr(searcher, "mesh", None) is not None
+              and getattr(getattr(searcher, "_index", None),
+                          "placement", "row") == "list")
+    routed_shapes = 0
+    # Warmup's dummy dispatches go through the real entry points;
+    # recording them would count synthetic traffic on the raft_merge_*
+    # scrape — and for routed searchers pour fake probe load onto the
+    # few lists nearest the all-zeros dummy, load the compactor's
+    # placement balancer would then migrate REAL lists by.
+    from raft_tpu.comms.topk_merge import merge_dispatch_stats
+
+    suppress = merge_dispatch_stats.suppress()
+    if routed:
+        import contextlib
+
+        from raft_tpu.parallel.routing import routing_stats
+        stack = contextlib.ExitStack()
+        stack.enter_context(suppress)
+        stack.enter_context(routing_stats.suppress())
+        suppress = stack
+    with CompileCounter() as counter, suppress:
         for qb, kb in shapes:
             dummy = np.zeros((qb, dim), np.float32)
             # degraded=False pins the healthy trace even when a shard is
@@ -165,7 +195,15 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
             searcher.search(dummy, kb, degraded=False)
             if include_degraded:
                 searcher.search(dummy, kb, degraded=True)
-    logger.debug("serve warmup: %s bucket shapes, %s XLA compiles, "
-                 "cache at %s", len(shapes), counter.count, effective_dir)
+            if routed:
+                from raft_tpu.parallel.ivf import sharded_routed_warmup
+
+                routed_shapes += sharded_routed_warmup(
+                    searcher.mesh, searcher._params, searcher._index,
+                    qb, kb, merge_engine=searcher.merge_engine)
+    logger.debug("serve warmup: %s bucket shapes (+%s routed plan "
+                 "shapes), %s XLA compiles, cache at %s", len(shapes),
+                 routed_shapes, counter.count, effective_dir)
     return {"shapes": len(shapes), "degraded": bool(include_degraded),
+            "routed_shapes": routed_shapes,
             "compile_events": counter.count, "cache_dir": effective_dir}

@@ -355,7 +355,10 @@ class BatchScheduler:
         # (old) epoch the entry is unreachable by construction.
         epoch = self.searcher.epoch
         try:
-            res = self.searcher.search(padded, kb, span=bspan)
+            # valid_rows: routed (placement="list") searchers must not
+            # route / meter the bucket's zero-pad rows as traffic.
+            res = self.searcher.search(padded, kb, span=bspan,
+                                       valid_rows=rows)
         except Exception as err:   # complete, never wedge the queue
             now = self._clock()
             for r in batch:

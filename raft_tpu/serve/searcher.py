@@ -195,6 +195,14 @@ class Searcher:
         and the metrics scrape describe the program actually served."""
         if self.mesh is None:
             return None
+        if getattr(self._index, "placement", "row") == "list":
+            # Routed dispatch: the chunk count follows the PLAN's local
+            # probe width (batch-dependent), not n_probes — a host-side
+            # prediction here would annotate a program that may not
+            # have run.  The routing telemetry (obs RoutingCollector /
+            # MergeDispatchCollector participants accounting) carries
+            # the routed dispatch story instead.
+            return None
         from raft_tpu.comms.topk_merge import (PIPELINED_ENGINES,
                                                resolve_merge_engine,
                                                resolve_pipeline_chunks)
@@ -221,7 +229,8 @@ class Searcher:
             return None
         return engine, n_chunks
 
-    def _dispatch(self, queries: np.ndarray, k: int, live):
+    def _dispatch(self, queries: np.ndarray, k: int, live,
+                  valid_rows=None):
         if self.kind == "brute_force":
             if self.mesh is None:
                 from raft_tpu.neighbors import brute_force
@@ -242,7 +251,8 @@ class Searcher:
             return sharded_ivf_flat_search(self.mesh, self._params,
                                            self._index, queries, k,
                                            merge_engine=self.merge_engine,
-                                           live_mask=live)
+                                           live_mask=live,
+                                           valid_rows=valid_rows)
         if self.mesh is None:
             from raft_tpu.neighbors import ivf_pq
 
@@ -252,11 +262,13 @@ class Searcher:
         return sharded_ivf_pq_search(self.mesh, self._params, self._index,
                                      queries, k,
                                      merge_engine=self.merge_engine,
-                                     live_mask=live)
+                                     live_mask=live,
+                                     valid_rows=valid_rows)
 
     def search(self, queries, k: int,
                degraded: Optional[bool] = None,
-               span=None) -> SearchResult:
+               span=None, valid_rows: Optional[int] = None
+               ) -> SearchResult:
         """One synchronous search, already shaped (the scheduler owns
         bucketing/padding). ``degraded=None`` auto-selects: the healthy
         trace while every shard is live, the live_mask trace (exact over
@@ -283,7 +295,7 @@ class Searcher:
         live = self._resolve_live(degraded)
 
         def attempt():
-            return self._dispatch(q, k, live)
+            return self._dispatch(q, k, live, valid_rows=valid_rows)
 
         import jax
 
@@ -458,7 +470,12 @@ class Searcher:
         from raft_tpu.lifecycle import compact as _compact
 
         with self._lock:
-            new, report = _compact(self._index, policy, mesh=self.mesh)
+            # Liveness gates the placement balancer (a re-balance must
+            # not assign lists onto a dead shard) — see compact().
+            new, report = _compact(
+                self._index, policy, mesh=self.mesh,
+                live_mask=(self.health.live_mask
+                           if self.health is not None else None))
             if report is None:
                 return None
             if pre_publish is not None:

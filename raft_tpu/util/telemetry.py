@@ -1,0 +1,50 @@
+"""Shared plumbing for host-side telemetry recorders.
+
+One definition of the thread-local suppression contract so the two
+recorders that honor it (``comms.topk_merge.MergeDispatchStats``,
+``parallel.routing.RoutingStats``) cannot drift: shadow traffic (the
+recall probe's exact scans, serve warmup's synthetic dispatches) runs
+through the SAME entry points the collectors meter, and each recorder
+must be able to drop this thread's records while such a caller is
+active.
+
+Ref: the reference has no metrics story (observability stops at NVTX
+ranges, core/nvtx.hpp) — this follows the Prometheus client-library
+convention of host-side recorders with caller-scoped suppression.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class SuppressibleStats:
+    """Mixin: thread-local record suppression for telemetry recorders.
+
+    Subclasses call ``self._suppressed()`` at the top of ``record`` and
+    return early when true; callers wrap shadow traffic in
+    ``with stats.suppress(): ...``.  Per-thread (a scraper or probe
+    thread suppressing itself never hides serving threads' records)
+    and re-entrant (nesting restores the previous state).
+    """
+
+    def __init__(self):
+        self._local = threading.local()
+
+    def _suppressed(self) -> bool:
+        return getattr(self._local, "off", False)
+
+    def suppress(self):
+        """Context manager: drop this THREAD's records while active."""
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = getattr(self._local, "off", False)
+            self._local.off = True
+            try:
+                yield
+            finally:
+                self._local.off = prev
+
+        return _ctx()

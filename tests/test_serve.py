@@ -565,7 +565,7 @@ class TestSearcher:
         fails = {"left": 2}
         orig = s._dispatch
 
-        def flaky(q, k, live):
+        def flaky(q, k, live, **kw):
             if fails["left"]:
                 fails["left"] -= 1
                 raise OSError("transient")
@@ -582,7 +582,7 @@ class TestSearcher:
         rng = np.random.default_rng(107)
         orig = s._dispatch
 
-        def explode(q, k, live):
+        def explode(q, k, live, **kw):
             raise RuntimeError("shard exploded")
 
         s._dispatch = explode
