@@ -1,0 +1,108 @@
+"""Durability bench family (ISSUE 17 satellite).
+
+Measures the write-ahead path (raft_tpu/lifecycle/wal),
+bench.py-style one-JSON-row-per-metric:
+
+* ``durability_wal_append_records_per_s`` — sustained mutation
+  throughput through a WAL-attached ``Searcher`` (device extend +
+  record encode + append, with and without fsync in the extras): the
+  write-ahead tax a live primary pays per commit.
+* ``durability_snapshot_s`` — one COW snapshot (``MutationLog
+  .snapshot`` riding the crash-safe ``sharded_ivf_save``).
+* ``durability_restore_s`` — loading that snapshot back
+  (``sharded_ivf_load`` + manifest verification), the fixed cost of
+  any recovery.
+* ``durability_replay_epochs_per_s`` — redo rate over the log tail
+  (``replay`` applying the appended records onto the restored base):
+  with the snapshot cadence this bounds recovery time, lag/rate.
+
+``quick=True`` is the CI smoke shape (tiny db, few records; tier-1
+runs it via tests/test_durability.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 3), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def run(quick: bool = False) -> None:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from raft_tpu.lifecycle.wal import MutationLog, replay
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import sharded_ivf_flat_build, sharded_ivf_load
+    from raft_tpu.serve import Searcher
+
+    rng = np.random.default_rng(17)
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("data",))
+    n_dev = len(devs)
+    if quick:
+        n, d, n_lists = 2048, 16, 8
+        batch, n_records = 64, 6
+    else:
+        n, d, n_lists = 131_072, 64, 128
+        batch, n_records = 512, 48
+
+    db = rng.normal(size=(n, d)).astype(np.float32)
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4)
+    sp = ivf_flat.SearchParams(n_probes=min(8, n_lists))
+    index = sharded_ivf_flat_build(mesh, params, db)
+
+    def _append_run(root, fsync):
+        log = MutationLog(root, n_parts=n_dev, fsync=fsync)
+        t0 = time.perf_counter()
+        log.snapshot(index, mesh)
+        snap_sec = time.perf_counter() - t0
+        s = Searcher.ivf_flat(index, sp, mesh=mesh, wal=log)
+        vecs = rng.normal(size=(batch, d)).astype(np.float32)
+        s.extend(vecs)                       # warm the extend trace
+        t0 = time.perf_counter()
+        for _ in range(n_records):
+            s.extend(vecs)
+        sec = time.perf_counter() - t0
+        _emit("durability_wal_append_records_per_s", n_records / sec,
+              "records/s", fsync=fsync, rows_per_record=batch, dim=d,
+              n_db=n, n_parts=n_dev)
+        return log, snap_sec
+
+    with tempfile.TemporaryDirectory() as tmp:
+        _append_run(os.path.join(tmp, "nofsync"), False)
+        log, snap_sec = _append_run(os.path.join(tmp, "fsync"), True)
+        _emit("durability_snapshot_s", snap_sec, "s",
+              n_db=n, dim=d, n_dev=n_dev)
+
+        # Recovery decomposed: restore the snapshot, then redo the tail.
+        snap_epoch, base = log.latest_snapshot()
+        t0 = time.perf_counter()
+        restored = sharded_ivf_load(mesh, base)
+        restore_sec = time.perf_counter() - t0
+        restored.epoch = snap_epoch
+        _emit("durability_restore_s", restore_sec, "s",
+              n_db=n, dim=d, n_dev=n_dev)
+
+        n_tail = 1 + n_records              # warm extend + timed loop
+        t0 = time.perf_counter()
+        replay(mesh, restored, log)
+        sec = time.perf_counter() - t0
+        _emit("durability_replay_epochs_per_s", n_tail / sec, "epochs/s",
+              n_records=n_tail, rows_per_record=batch, dim=d)
+        log.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

@@ -19,7 +19,11 @@
 #     randomness, so a CI failure replays bit-for-bit locally with
 #     `pytest -m chaos`) — includes the lifecycle races: seeded
 #     delete/upsert/compaction interleavings against live serving and
-#     the failed-compaction-publishes-nothing pre_publish fault;
+#     the failed-compaction-publishes-nothing pre_publish fault; plus
+#     the durability grid: kill-at-every-point WAL recovery
+#     (pre-append / torn-frame / post-append at each mutation step),
+#     torn-write/dropped-rename crash-safe save, resize-under-traffic
+#     (tests/test_durability.py, tests/test_elastic.py);
 #   * sanitize: the runtime cross-check of the analyzer's host-sync
 #     claim — marked hot-path tests re-run in isolation under
 #     jax.transfer_guard("disallow") + CompileCounter (zero guarded

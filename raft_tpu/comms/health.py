@@ -74,6 +74,21 @@ class ShardHealth:
 
         return remove
 
+    def watch(self, rank: int, on_dead: Callable[[], None]
+              ) -> Callable[[], None]:
+        """Subscribe ``on_dead()`` to ONE rank's live->dead transition —
+        the promotion trigger (``lifecycle.wal.PromotionManager`` arms
+        a follower with it).  Revive transitions are ignored (dead
+        ranks never auto-revive; a promotion must not un-happen).
+        Returns the idempotent unsubscribe callable."""
+        self._check_rank(rank)
+
+        def cb(r: int, live: bool) -> None:
+            if r == rank and not live:
+                on_dead()
+
+        return self.add_listener(cb)
+
     def _fire(self, rank: int, live: bool) -> None:
         """Invoke listeners OUTSIDE the lock (a listener may take its
         own lock; holding ours across foreign code invites inversions).

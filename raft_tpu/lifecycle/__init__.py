@@ -18,8 +18,19 @@ turns the read-mostly serving stack (raft_tpu/serve) into a database:
   and reclusters drifted ones), publishing a copy-on-write successor
   index at ``epoch + 1``: in-flight batches and cached results keep
   their pre-compaction snapshot (snapshot-at-dispatch semantics).
+* :class:`MutationLog` / :func:`replay` / :func:`recover` — the
+  durable write-ahead log (lifecycle/wal.py): every committed mutation
+  appends an epoch-stamped record before it publishes, periodic COW
+  snapshots ride ``sharded_ivf_save``, and a crash replays the log
+  tail over the newest snapshot — bit-identical, never half-applied.
+* :class:`Follower` / :class:`PromotionManager` — read-only endpoints
+  tailing the log; primary loss promotes by catch-up, not rebuild.
+* :func:`join_shard` / :func:`leave_shard` — elastic serving-set
+  membership over a fixed mesh (lifecycle/elastic.py): whole-list
+  migration re-packs the placement, the new routing ladder warms in
+  the background, one published epoch bump cuts over.
 
-See docs/index_lifecycle.md.
+See docs/index_lifecycle.md and docs/durability.md.
 """
 
 from raft_tpu.lifecycle.delete import (
@@ -34,8 +45,31 @@ from raft_tpu.lifecycle.compact import (
     Compactor,
     compact,
 )
+from raft_tpu.lifecycle.wal import (
+    Follower,
+    MutationLog,
+    PromotionManager,
+    WalCorruption,
+    WalRecord,
+    WalStats,
+    apply_record,
+    recover,
+    replay,
+)
+from raft_tpu.lifecycle.elastic import (
+    ElasticReport,
+    ElasticStats,
+    elastic_stats,
+    join_shard,
+    leave_shard,
+    serving_shards,
+)
 
 __all__ = [
     "delete", "upsert", "enable_tombstones", "tombstone_frac",
     "compact", "CompactionPolicy", "CompactionReport", "Compactor",
+    "MutationLog", "WalRecord", "WalStats", "WalCorruption",
+    "apply_record", "replay", "recover", "Follower", "PromotionManager",
+    "ElasticReport", "ElasticStats", "elastic_stats",
+    "join_shard", "leave_shard", "serving_shards",
 ]

@@ -97,7 +97,7 @@ def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
             int(raw.min()))
     width = next_pow2(int(raw.size))
     dtype = np.dtype(index.indices.dtype)
-    padded = np.full((width,), PAD_ID, dtype)
+    padded = np.full((width,), PAD_ID, dtype)  # analyze: host-sync-ok (eager host-side id padding, once per delete batch — never inside a compiled program)
     padded[:raw.size] = raw.astype(dtype)
     if _is_sharded(index):
         return jax.device_put(jnp.asarray(padded),

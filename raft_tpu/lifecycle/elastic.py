@@ -1,0 +1,208 @@
+"""Elastic mesh membership: live shard join/leave under one epoch bump.
+
+Ref: the reference's MNMG deployment is rank-count-pinned — an index
+serialized on N GPUs deserializes only on N GPUs (ivf_pq
+detail/serialize, docs/source/using_comms.rst) and the ANN shard set
+is fixed for the process lifetime.  Here the MESH stays fixed (the JAX
+device set of one program) but the SERVING set of shards under
+``placement="list"`` is elastic: :func:`join_shard` spreads lists onto
+a shard that was idle, :func:`leave_shard` drains one before it is
+retired — both while the searcher keeps answering queries.
+
+Mechanics (PR 13's whole-list migration is the rebalance step):
+
+1. Re-pack the owner assignment over the post-resize ACTIVE shard set
+   (``assign_lists(active=...)`` — centroid-affinity packing, so probe
+   locality survives the resize).
+2. Build the copy-on-write successor with
+   :func:`~raft_tpu.parallel.ivf.sharded_migrate_lists` (replicated
+   lists keep a second live copy, re-placed off a leaver).
+3. Warm the successor's routed dispatch ladder in the BACKGROUND —
+   serving continues on the predecessor while
+   :func:`~raft_tpu.parallel.ivf.sharded_routed_warmup` pre-compiles
+   every (q_bucket, k) plan shape against the prospective placement
+   (stats suppressed, like ``serve.bucketing.warmup``) — so cutover
+   does not compile in the hot path.
+4. Cut over under ONE published epoch bump
+   (``Searcher.publish_index``), logging a ``migrate`` record when a
+   mutation log is attached — an elastic resize is replayable like any
+   other mutation.
+
+A leave is migrate-out **then** drop: the leaver participates in the
+migration collective (its rows are the ones moving) and only the
+published successor stops routing to it; the replica placement is
+handed a live-mask that already excludes the leaver, so no replica
+lands on the shard being retired.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import logger
+
+
+@dataclass(frozen=True)
+class ElasticReport:
+    """What one join/leave did (telemetry surface)."""
+
+    action: str               # "join" | "leave"
+    rank: int
+    active_before: Tuple[int, ...]
+    active_after: Tuple[int, ...]
+    lists_moved: int
+    warmed_shapes: int
+    epoch: int                # the published successor's epoch
+
+
+class ElasticStats:
+    """Host-side join/leave counters for the metrics scrape
+    (``obs.registry.ElasticCollector``)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.joins = 0
+        self.leaves = 0
+        self.lists_moved = 0
+        self.last_epoch = 0
+
+    def record(self, report: ElasticReport) -> None:
+        with self._lock:
+            if report.action == "join":
+                self.joins += 1
+            else:
+                self.leaves += 1
+            self.lists_moved += report.lists_moved
+            self.last_epoch = report.epoch
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(joins=self.joins, leaves=self.leaves,
+                        lists_moved=self.lists_moved,
+                        last_epoch=self.last_epoch)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.joins = self.leaves = self.lists_moved = 0
+            self.last_epoch = 0
+
+
+#: Process-wide elastic telemetry (the scrape adapter reads it).
+elastic_stats = ElasticStats()
+
+
+def serving_shards(index) -> Tuple[int, ...]:
+    """The ACTIVE serving set: shards owning at least one list under
+    the current placement (sorted ids)."""
+    pm = index.placement_map
+    expects(pm is not None, "elastic membership needs placement='list'")
+    return tuple(int(s) for s in np.unique(pm.owner))
+
+
+def _resize(searcher, rank: int, join: bool, grid=None) -> ElasticReport:
+    import jax
+
+    from raft_tpu.comms.topk_merge import merge_dispatch_stats
+    from raft_tpu.parallel.ivf import (_routed_sizes_h,
+                                       sharded_migrate_lists,
+                                       sharded_routed_warmup)
+    from raft_tpu.parallel.routing import assign_lists, routing_stats
+
+    expects(searcher.mesh is not None,
+            "elastic join/leave needs a sharded searcher")
+    searcher._require_writable()
+    index = searcher._index
+    pm = index.placement_map
+    expects(pm is not None,
+            "elastic join/leave needs placement='list' (row placement "
+            "has no whole-list migration unit)")
+    expects(0 <= rank < pm.n_dev,
+            "rank %s outside the mesh's %s shards — the JAX device set "
+            "is fixed per process; elastic membership moves lists "
+            "across it", rank, pm.n_dev)
+    before = set(serving_shards(index))
+    active = set(before)
+    if join:
+        expects(rank not in active,
+                "shard %s already serves lists — nothing to join", rank)
+        active.add(rank)
+    else:
+        expects(rank in active,
+                "shard %s serves no lists — nothing to leave", rank)
+        active.discard(rank)
+        expects(bool(active),
+                "cannot drain the last serving shard %s", rank)
+
+    base_epoch = int(index.epoch)
+    weights = _routed_sizes_h(index).astype(np.float64)
+    centers = np.asarray(  # analyze: host-sync-ok (resize pass, once per join/leave)
+        jax.device_get(index.centers))
+    new_owner = assign_lists(weights, pm.n_dev, centers=centers,
+                             active=sorted(active))
+    # Replicas re-place against a live set that excludes a leaver —
+    # migrate-out must not park the fault-tolerance copy on the shard
+    # being retired.
+    live = np.ones(pm.n_dev, bool)
+    if not join:
+        live[rank] = False
+    successor, n_moved = sharded_migrate_lists(searcher.mesh, index,
+                                               new_owner, live_mask=live)
+
+    # Background warmup: the predecessor keeps serving while the
+    # successor's routed plan ladder pre-compiles.  Suppress synthetic
+    # traffic from both telemetry singletons (serve.bucketing.warmup's
+    # contract) — warmup probes on the PROSPECTIVE placement must not
+    # feed the balancer or the merge scrape.
+    warmed = 0
+    if grid is not None:
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            stack.enter_context(merge_dispatch_stats.suppress())
+            stack.enter_context(routing_stats.suppress())
+            for qb, kb in grid.shapes():
+                warmed += sharded_routed_warmup(
+                    searcher.mesh, searcher._params, successor, qb, kb,
+                    merge_engine=searcher.merge_engine)
+
+    # ONE published epoch bump cuts the whole resize over; the migrate
+    # record makes it replayable (lifecycle/wal.py).
+    searcher.publish_index(
+        successor,
+        record=("migrate", dict(owner=np.asarray(new_owner, np.int32),
+                                live=live)),
+        expect_base_epoch=base_epoch)
+    report = ElasticReport(
+        action="join" if join else "leave", rank=rank,
+        active_before=tuple(sorted(before)),
+        active_after=tuple(sorted(active)),
+        lists_moved=n_moved, warmed_shapes=warmed,
+        epoch=int(successor.epoch))
+    elastic_stats.record(report)
+    logger.debug("elastic %s: shard %s, %s lists moved, %s shapes "
+                 "warmed, epoch %s", report.action, rank, n_moved,
+                 warmed, report.epoch)
+    return report
+
+
+def join_shard(searcher, rank: int, grid=None) -> ElasticReport:
+    """Bring ``rank`` into the serving set: migrate lists onto it
+    (affinity-aware re-pack over the grown active set), warm the new
+    routing ladder against ``grid`` (a
+    :class:`~raft_tpu.serve.bucketing.BucketGrid`; None skips warmup),
+    then cut over under one published epoch bump.  Replicated lists
+    stay replicated across the move."""
+    return _resize(searcher, rank, join=True, grid=grid)
+
+
+def leave_shard(searcher, rank: int, grid=None) -> ElasticReport:
+    """Drain ``rank`` out of the serving set: migrate its lists to the
+    survivors (replicas re-placed off the leaver), warm, cut over.
+    The shard's devices stay in the mesh — after the publish no query
+    routes to it, so the host behind it can be retired."""
+    return _resize(searcher, rank, join=False, grid=grid)

@@ -1,0 +1,662 @@
+"""Write-ahead mutation log: durable replay, snapshots, promotion.
+
+Ref: the reference can serialize an index (``ivf_flat::serialize`` /
+``ivf_pq::serialize``, cpp/include/raft/neighbors/detail/*_serialize.cuh)
+but every mutation since the last save dies with the process;
+FreshDiskANN (arXiv:2105.09613) pairs its in-memory delta index with a
+durable change log so a crash replays instead of rebuilding.  This
+module is that log for the mutable sharded indexes (PR 8's
+epoch-per-mutation contract + PR 13's list placement):
+
+* **Record stream** — every committed mutation (extend / delete /
+  upsert / compact / migrate) appends ONE CRC-framed, epoch-stamped
+  record before the serving reference swaps.  The epoch bump IS the
+  commit point: a record exists iff its epoch was published, so a kill
+  between append and swap re-applies on replay (redo) and a kill before
+  the append loses an unpublished mutation no reader ever saw
+  (rollback).  Epochs advance by exactly one per record, so replay
+  detects a torn mid-stream record as an epoch gap and stops at the
+  last complete epoch — never a half-applied batch.
+* **Segments** — records append to per-part segment files
+  (``root/part{p}/seg-*.wal``; a record lands in part
+  ``epoch % n_parts``, the deterministic round-robin that shards the
+  log alongside :class:`~raft_tpu.parallel.routing.ListPlacement`
+  owners — pass ``n_parts = placement.n_dev``).  Appends fsync through
+  the injectable :class:`~raft_tpu.util.atomic_io.FileIO` seam (the
+  chaos harness tears them at scripted byte offsets); a torn tail is
+  tolerated on each part's LAST segment and repaired (truncated to the
+  last clean frame) when the writer reopens.  A torn SEALED segment is
+  real corruption and raises :class:`WalCorruption`.
+* **Snapshots** — periodic COW snapshots ride the crash-safe
+  :func:`~raft_tpu.parallel.ivf.sharded_ivf_save` under fresh
+  ``snapshots/snap-{epoch}`` basenames (manifest-last, so a kill
+  mid-snapshot leaves the previous snapshot authoritative);
+  :func:`recover` loads the newest verifiable snapshot and replays the
+  tail of the log over it — recovery is replay, not rebuild.
+* **Followers** — a read-only :class:`Follower` tails the log under the
+  same snapshot-swap publish contract; on primary loss
+  :class:`PromotionManager` (fed by ``ShardHealth``'s transition
+  listener) catches the follower up to the log head and flips it
+  writable.
+
+Record frame (little-endian)::
+
+    <4s I  I    Q     Q   Q           I    > + payload
+    RWAL ver kind  epoch seq payload_len crc32(payload)
+
+The payload is an ``np.savez`` archive of the mutation's host inputs —
+what replay feeds back through the ordinary lifecycle mutators, which
+are deterministic given (index state, inputs), making replay
+bit-identical by construction.  Compaction's placement balancer is the
+one non-deterministic input (it reads process-local
+``routing_stats`` traffic), so compact records store the *outcome*
+(the final owner assignment) and replay migrates to it directly.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import glob
+import io
+import os
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from raft_tpu.core.error import RaftError, expects
+from raft_tpu.core.logger import logger
+from raft_tpu.util.atomic_io import DEFAULT_IO, FileIO, crc32, savez_bytes
+
+_MAGIC = b"RWAL"
+WAL_VERSION = 1
+#: Record kinds in wire order (the header stores the tuple index).
+RECORD_KINDS = ("extend", "delete", "upsert", "compact", "migrate")
+_HEADER = struct.Struct("<4sIIQQQI")
+
+
+class WalCorruption(RaftError):
+    """A sealed log segment failed frame validation — unlike a torn
+    tail on the open segment (tolerated + repaired), this means bytes
+    the log already durably committed changed under it."""
+
+
+@dataclass(frozen=True)
+class WalRecord:
+    """One decoded log record. ``epoch`` is the POST-mutation index
+    epoch (the committed version this record produces); ``seq`` is the
+    log-global append order (total order across parts)."""
+
+    kind: str
+    epoch: int
+    seq: int
+    payload: bytes
+
+    @property
+    def arrays(self) -> Dict[str, np.ndarray]:
+        with np.load(io.BytesIO(self.payload), allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+
+def encode_record(kind: str, epoch: int, seq: int, arrays) -> bytes:
+    """Frame one record: header + savez payload, CRC over the payload."""
+    expects(kind in RECORD_KINDS, "unknown record kind %r", kind)
+    payload = savez_bytes(**arrays)
+    header = _HEADER.pack(_MAGIC, WAL_VERSION, RECORD_KINDS.index(kind),
+                          int(epoch), int(seq), len(payload),
+                          crc32(payload))
+    return header + payload
+
+
+def decode_records(data: bytes, *, tolerate_tail: bool = True
+                   ) -> Tuple[List[WalRecord], int]:
+    """Decode frames from ``data``; returns ``(records, clean_end)``.
+
+    Stops at the first invalid frame (short header, bad magic/version,
+    short payload, CRC mismatch): with ``tolerate_tail`` the valid
+    prefix is returned and ``clean_end`` marks where the writer should
+    truncate-and-resume; without it the invalid frame raises
+    :class:`WalCorruption` (sealed segments must decode completely)."""
+    out: List[WalRecord] = []
+    off, n = 0, len(data)
+    while off < n:
+        bad = None
+        if off + _HEADER.size > n:
+            bad = "short header"
+        else:
+            magic, version, kind_i, epoch, seq, plen, crc = \
+                _HEADER.unpack_from(data, off)
+            if magic != _MAGIC:
+                bad = "bad magic"
+            elif version != WAL_VERSION:
+                bad = f"bad version {version}"
+            elif kind_i >= len(RECORD_KINDS):
+                bad = f"bad kind {kind_i}"
+            elif off + _HEADER.size + plen > n:
+                bad = "short payload"
+            else:
+                payload = bytes(data[off + _HEADER.size:
+                                     off + _HEADER.size + plen])
+                if crc32(payload) != crc:
+                    bad = "payload CRC mismatch"
+        if bad is not None:
+            if tolerate_tail:
+                break
+            raise WalCorruption(
+                f"invalid frame at byte {off}: {bad} "
+                f"(sealed segment must decode completely)")
+        out.append(WalRecord(RECORD_KINDS[kind_i], int(epoch), int(seq),
+                             payload))
+        off += _HEADER.size + plen
+    return out, off
+
+
+@dataclass
+class WalStats:
+    """Host-side counters one :class:`MutationLog` feeds and the
+    metrics scrape (``obs.registry.WalCollector``) reads — scrapes must
+    never touch files or device state.  fsync latencies accumulate in a
+    pending list the collector drains into its histogram at scrape
+    time."""
+
+    records: int = 0
+    bytes: int = 0
+    fsyncs: int = 0
+    fsync_total_s: float = 0.0
+    snapshots: int = 0
+    head_epoch: int = 0
+    last_snapshot_epoch: int = 0
+
+    def __post_init__(self):
+        self._lock = threading.Lock()
+        self._pending_fsync_s: List[float] = []
+
+    def record_append(self, n_bytes: int, epoch: int) -> None:
+        with self._lock:
+            self.records += 1
+            self.bytes += int(n_bytes)
+            self.head_epoch = max(self.head_epoch, int(epoch))
+
+    def record_fsync(self, seconds: float) -> None:
+        with self._lock:
+            self.fsyncs += 1
+            self.fsync_total_s += float(seconds)
+            self._pending_fsync_s.append(float(seconds))
+
+    def drain_fsyncs(self) -> List[float]:
+        """Hand pending fsync latencies to the scrape-side histogram
+        (each latency is observed exactly once across scrapes)."""
+        with self._lock:
+            out, self._pending_fsync_s = self._pending_fsync_s, []
+            return out
+
+    def record_snapshot(self, epoch: int) -> None:
+        with self._lock:
+            self.snapshots += 1
+            self.last_snapshot_epoch = int(epoch)
+            self.head_epoch = max(self.head_epoch, int(epoch))
+
+
+class LogWriter:
+    """Append-only segment writer for ONE log part directory.
+
+    On open, the newest segment's tail is validated and a torn tail
+    (power loss mid-append) is truncated back to the last clean frame —
+    the repaired file then keeps appending.  Rotation seals a segment
+    at ``segment_bytes`` and opens the next; sealed segments are
+    immutable and must decode completely."""
+
+    def __init__(self, part_dir: str, *, file_io: FileIO = DEFAULT_IO,
+                 fsync: bool = True, segment_bytes: int = 4 << 20,
+                 stats: Optional[WalStats] = None,
+                 monotonic: Callable[[], float] = time.monotonic):
+        os.makedirs(part_dir, exist_ok=True)
+        self.part_dir = part_dir
+        self.file_io = file_io
+        self.fsync = fsync
+        self.segment_bytes = segment_bytes
+        self.stats = stats
+        self._monotonic = monotonic
+        self._f = None
+        segs = self.segments()
+        if segs:
+            self._repair_tail(segs[-1])
+            self._seg_index = len(segs) - 1
+            self._open(segs[-1])
+        else:
+            self._seg_index = 0
+            self._open(self._seg_path(0))
+
+    def _seg_path(self, i: int) -> str:
+        return os.path.join(self.part_dir, f"seg-{i:08d}.wal")
+
+    def segments(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.part_dir, "seg-*.wal")))
+
+    def _repair_tail(self, path: str) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        _, clean_end = decode_records(data, tolerate_tail=True)
+        if clean_end < len(data):
+            logger.warning("wal: truncating torn tail of %s at byte %s "
+                           "(was %s)", path, clean_end, len(data))
+            with open(path, "r+b") as f:
+                f.truncate(clean_end)
+
+    def _open(self, path: str) -> None:
+        self._f = open(path, "ab")
+
+    def append(self, frame: bytes) -> None:
+        """Append one encoded frame; rotates first when the open
+        segment is full, fsyncs after (the durability point)."""
+        if self._f.tell() >= self.segment_bytes:
+            self._f.close()
+            self._seg_index += 1
+            self._open(self._seg_path(self._seg_index))
+        self.file_io.write_bytes(self._f, frame)
+        if self.fsync:
+            t0 = self._monotonic()
+            self.file_io.fsync(self._f)
+            if self.stats is not None:
+                self.stats.record_fsync(self._monotonic() - t0)
+        else:
+            self._f.flush()
+
+    def read(self) -> List[WalRecord]:
+        """All records in this part (file order).  The open (last)
+        segment tolerates a torn tail; sealed segments raise
+        :class:`WalCorruption` on any bad frame."""
+        self._f.flush()
+        segs = self.segments()
+        out: List[WalRecord] = []
+        for i, path in enumerate(segs):
+            with open(path, "rb") as f:
+                data = f.read()
+            recs, _ = decode_records(data,
+                                     tolerate_tail=(i == len(segs) - 1))
+            out.extend(recs)
+        return out
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def _snap_basename(root: str, epoch: int) -> str:
+    return os.path.join(root, "snapshots", f"snap-{epoch:012d}")
+
+
+class MutationLog:
+    """The durable mutation log of one sharded index.
+
+    Layout under ``root``::
+
+        root/part{0..n_parts-1}/seg-*.wal    record segments
+        root/snapshots/snap-{epoch:012d}.*   sharded_ivf_save file sets
+
+    A record appends to part ``epoch % n_parts`` — the deterministic
+    round-robin that spreads log I/O like the list placement spreads
+    probe load (pass ``n_parts = placement.n_dev``; a strictly per-list
+    split would need a device readback of which lists each mutation
+    touched, so the epoch modulus is the honest host-side sharding).
+    Readers merge parts back into total (epoch, seq) order.
+
+    ``post_append`` is the chaos hook fired AFTER a record is durable
+    but before control returns to the publisher — a fault injected
+    there simulates a kill between commit and the in-memory swap (the
+    redo case of recovery).
+    """
+
+    def __init__(self, root: str, *, n_parts: int = 1,
+                 segment_bytes: int = 4 << 20,
+                 file_io: FileIO = DEFAULT_IO, fsync: bool = True,
+                 snapshot_every: int = 0, retry=None,
+                 stats: Optional[WalStats] = None,
+                 post_append: Optional[Callable[[], None]] = None,
+                 monotonic: Callable[[], float] = time.monotonic):
+        expects(n_parts >= 1, "n_parts must be >= 1, got %s", n_parts)
+        existing = sorted(glob.glob(os.path.join(root, "part*")))
+        expects(not existing or len(existing) == n_parts,
+                "log at %r has %s parts, opened with n_parts=%s — the "
+                "epoch->part modulus would scatter records", root,
+                len(existing), n_parts)
+        self.root = root
+        self.n_parts = n_parts
+        self.retry = retry
+        self.file_io = file_io
+        self.snapshot_every = snapshot_every
+        self.stats = stats if stats is not None else WalStats()
+        self.post_append = post_append
+        self._lock = threading.Lock()
+        self._writers = [
+            LogWriter(os.path.join(root, f"part{p}"), file_io=file_io,
+                      fsync=fsync, segment_bytes=segment_bytes,
+                      stats=self.stats, monotonic=monotonic)
+            for p in range(n_parts)]
+        # Resume seq/head from what survived on disk (plus any snapshot
+        # newer than the log tail).
+        recs = self.records()
+        self._seq = (max(r.seq for r in recs) + 1) if recs else 0
+        head = max(r.epoch for r in recs) if recs else 0
+        snap = self.latest_snapshot()
+        if snap is not None:
+            head = max(head, snap[0])
+        self.stats.head_epoch = max(self.stats.head_epoch, head)
+
+    # -- append ------------------------------------------------------------
+    def append(self, kind: str, epoch: int, arrays) -> WalRecord:
+        """Durably append one record (fsynced before return). The
+        caller (``Searcher``) swaps the serving reference only AFTER
+        this returns — write-ahead order."""
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            frame = encode_record(kind, epoch, seq, arrays)
+            self._writers[int(epoch) % self.n_parts].append(frame)
+            self.stats.record_append(len(frame), epoch)
+        if self.post_append is not None:
+            self.post_append()
+        return WalRecord(kind, int(epoch), seq, frame[_HEADER.size:])
+
+    # -- read --------------------------------------------------------------
+    def records(self, *, from_epoch: int = 0,
+                to_epoch: Optional[int] = None) -> List[WalRecord]:
+        """All surviving records with ``from_epoch <= epoch`` (and
+        ``<= to_epoch`` when given), merged across parts into total
+        (epoch, seq) order."""
+        out: List[WalRecord] = []
+        for w in self._writers:
+            out.extend(w.read())
+        out.sort(key=lambda r: (r.epoch, r.seq))
+        return [r for r in out
+                if r.epoch >= from_epoch
+                and (to_epoch is None or r.epoch <= to_epoch)]
+
+    def head_epoch(self) -> int:
+        """Newest committed epoch on disk (records or snapshot)."""
+        recs = self.records()
+        head = max((r.epoch for r in recs), default=0)
+        snap = self.latest_snapshot()
+        if snap is not None:
+            head = max(head, snap[0])
+        return head
+
+    # -- snapshots ---------------------------------------------------------
+    def snapshot(self, index, mesh) -> str:
+        """Write a full COW snapshot of ``index`` at its current epoch
+        via the crash-safe ``sharded_ivf_save`` (fresh basename per
+        epoch + manifest-last: a kill mid-snapshot leaves the previous
+        snapshot authoritative, never a torn latest)."""
+        from raft_tpu.parallel.ivf import sharded_ivf_save
+
+        base = _snap_basename(self.root, int(index.epoch))
+        os.makedirs(os.path.dirname(base), exist_ok=True)
+        sharded_ivf_save(base, index, retry=self.retry,
+                         file_io=self.file_io)
+        self.stats.record_snapshot(int(index.epoch))
+        return base
+
+    def maybe_snapshot(self, index, mesh) -> Optional[str]:
+        """Snapshot when the index has advanced ``snapshot_every``
+        epochs past the last snapshot (0 = never automatic)."""
+        if self.snapshot_every <= 0:
+            return None
+        if (int(index.epoch) - self.stats.last_snapshot_epoch
+                < self.snapshot_every):
+            return None
+        return self.snapshot(index, mesh)
+
+    def latest_snapshot(self) -> Optional[Tuple[int, str]]:
+        """Newest VERIFIABLE snapshot as ``(epoch, basename)``, or
+        None.  A torn newest snapshot (kill mid-save) fails manifest
+        verification and falls back to the next older one."""
+        from raft_tpu.parallel.ivf import verify_sharded_manifest
+
+        pattern = os.path.join(self.root, "snapshots",
+                               "snap-*.manifest.npz")
+        for mpath in sorted(glob.glob(pattern), reverse=True):
+            base = mpath[:-len(".manifest.npz")]
+            try:
+                epoch = verify_sharded_manifest(base)
+            except RaftError as err:
+                logger.warning("wal: skipping torn snapshot %s (%s)",
+                               base, err)
+                continue
+            if epoch is not None:
+                return int(epoch), base
+        return None
+
+    def truncate(self, up_to_epoch: int) -> int:
+        """Drop SEALED segments whose every record is ``<= up_to_epoch``
+        (typically the last snapshot's epoch — replay never needs them
+        again). The open segment always survives. Returns segments
+        removed."""
+        removed = 0
+        for w in self._writers:
+            for path in w.segments()[:-1]:
+                with open(path, "rb") as f:
+                    recs, _ = decode_records(f.read(),
+                                             tolerate_tail=False)
+                if all(r.epoch <= up_to_epoch for r in recs):
+                    os.remove(path)
+                    removed += 1
+        return removed
+
+    def close(self) -> None:
+        for w in self._writers:
+            w.close()
+
+
+# -- replay -----------------------------------------------------------------
+
+def _policy_payload(policy) -> Dict[str, np.ndarray]:
+    """Compaction policy as record arrays (balance stripped — see the
+    module docstring; None encodes as -1)."""
+    return dict(
+        trigger_frac=np.float64(policy.trigger_frac),
+        shrink_capacity=np.int64(int(policy.shrink_capacity)),
+        split_above=np.float64(-1.0 if policy.split_above is None
+                               else policy.split_above),
+        drift_threshold=np.float64(-1.0 if policy.drift_threshold is None
+                                   else policy.drift_threshold),
+        min_split_rows=np.int64(policy.min_split_rows))
+
+
+def _policy_from_payload(a):
+    from raft_tpu.lifecycle.compact import CompactionPolicy
+
+    def opt(x):
+        x = float(x)
+        return None if x < 0 else x
+
+    return CompactionPolicy(
+        trigger_frac=float(a["trigger_frac"]),
+        shrink_capacity=bool(int(a["shrink_capacity"])),
+        split_above=opt(a["split_above"]),
+        drift_threshold=opt(a["drift_threshold"]),
+        min_split_rows=int(a["min_split_rows"]))
+
+
+def apply_record(mesh, index, rec: WalRecord):
+    """Apply ONE record to a COW copy of ``index`` through the ordinary
+    lifecycle mutators; returns the successor at exactly ``rec.epoch``
+    (asserted — a mismatch means the log and the index diverged)."""
+    from raft_tpu.lifecycle.compact import compact as _compact
+    from raft_tpu.lifecycle.delete import delete as _delete
+    from raft_tpu.lifecycle.delete import upsert as _upsert
+    from raft_tpu.parallel import ivf as _pivf
+
+    a = rec.arrays
+    if rec.kind == "extend":
+        fn = (_pivf.sharded_ivf_pq_extend
+              if isinstance(index, _pivf.ShardedIvfPq)
+              else _pivf.sharded_ivf_flat_extend)
+        index = copy.copy(index)
+        fn(mesh, index, a["vectors"], a["ids"], donate=False)
+    elif rec.kind == "delete":
+        index = copy.copy(index)
+        n = _delete(index, a["ids"], mesh=mesh)
+        expects(n > 0, "replayed delete (epoch %s) tombstoned nothing — "
+                "the record was only written for a non-empty delete",
+                rec.epoch)
+    elif rec.kind == "upsert":
+        index = copy.copy(index)
+        _upsert(index, a["vectors"], a["ids"], mesh=mesh, donate=False)
+    elif rec.kind == "compact":
+        new, _report = _compact(index, _policy_from_payload(a), mesh=mesh)
+        if "owner" in a:
+            # The original pass balanced the placement; replay migrates
+            # straight to the recorded outcome (routing_stats traffic
+            # is process-local and gone — the one input replay cannot
+            # re-derive).
+            new, _ = _pivf.sharded_migrate_lists(
+                mesh, new, a["owner"],
+                live_mask=a["live"] if "live" in a else None)
+        # One published bump per pass regardless of how many internal
+        # steps replay took — mirror compact()'s own epoch fixup.
+        index = dataclasses.replace(new, epoch=rec.epoch)
+    elif rec.kind == "migrate":
+        index, _ = _pivf.sharded_migrate_lists(
+            mesh, index, a["owner"],
+            live_mask=a["live"] if "live" in a else None)
+    else:  # pragma: no cover - encode_record validates kinds
+        raise WalCorruption(f"unknown record kind {rec.kind!r}")
+    expects(int(index.epoch) == rec.epoch,
+            "replay diverged: record epoch %s produced index epoch %s",
+            rec.epoch, int(index.epoch))
+    return index
+
+
+def replay(mesh, index, log: MutationLog, *,
+           to_epoch: Optional[int] = None):
+    """Re-apply every committed record after ``index.epoch`` (up to
+    ``to_epoch`` when given) in total order.  Epochs advance by exactly
+    one per record, so a gap (a torn record decode dropped, with later
+    parts still holding newer records) stops the replay at the last
+    complete epoch — torn mid-stream records roll back, never
+    half-apply."""
+    for rec in log.records(from_epoch=int(index.epoch) + 1,
+                           to_epoch=to_epoch):
+        if rec.epoch != int(index.epoch) + 1:
+            logger.warning(
+                "wal: epoch gap at record %s (index at %s) — stopping "
+                "replay at the last complete epoch", rec.epoch,
+                int(index.epoch))
+            break
+        index = apply_record(mesh, index, rec)
+    return index
+
+
+def recover(mesh, root: str, *, to_epoch: Optional[int] = None,
+            retry=None, **log_kwargs):
+    """Reconstruct the index at the newest complete epoch (or
+    ``to_epoch``): load the newest verifiable snapshot, replay the log
+    tail over it.  Returns ``(index, log)`` — the log is open for
+    further appends (a promoted follower keeps writing to it).
+
+    ``retry`` retries snapshot file I/O on transient ``OSError``
+    (``sharded_ivf_load(retry=)``)."""
+    from raft_tpu.parallel.ivf import sharded_ivf_load
+
+    log = MutationLog(root, retry=retry, **log_kwargs)
+    snap = log.latest_snapshot()
+    expects(snap is not None,
+            "no snapshot under %r — write one (MutationLog.snapshot) "
+            "when the log is created, before mutations append", root)
+    snap_epoch, base = snap
+    index = sharded_ivf_load(mesh, base, retry=retry)
+    # Epoch is process-local state (deliberately not serialized in the
+    # model file); the snapshot manifest carries it so replay can line
+    # records up.  analyze: epoch-bump-ok (restoring the snapshot's
+    # committed epoch, not minting a new one)
+    index.epoch = snap_epoch
+    return replay(mesh, index, log, to_epoch=to_epoch), log
+
+
+# -- followers + promotion --------------------------------------------------
+
+class Follower:
+    """A read-only serving endpoint tailing a :class:`MutationLog`.
+
+    The follower's ``Searcher`` is constructed ``writable=False`` over
+    a recovered index; :meth:`catch_up` replays newly committed records
+    and publishes each advance under the searcher's snapshot-swap
+    contract (readers never block, never see a half-applied state).
+    ``lag`` is epochs behind the head AS OF the last catch-up/poll — a
+    host counter the metrics scrape reads without touching files."""
+
+    def __init__(self, searcher, log: MutationLog):
+        expects(getattr(searcher, "mesh", None) is not None,
+                "a follower tails a sharded searcher")
+        searcher.writable = False
+        self.searcher = searcher
+        self.log = log
+        self._head_seen = int(searcher._index.epoch)
+
+    @property
+    def epoch(self) -> int:
+        return int(self.searcher._index.epoch)
+
+    @property
+    def lag(self) -> int:
+        """Epochs behind the log head as of the last catch_up/poll."""
+        return max(0, self._head_seen - self.epoch)
+
+    def poll(self) -> int:
+        """Refresh the head-epoch watermark from disk; returns lag."""
+        self._head_seen = max(self._head_seen, self.log.head_epoch())
+        return self.lag
+
+    def catch_up(self, *, to_epoch: Optional[int] = None) -> int:
+        """Replay committed records past the follower's epoch and
+        publish the result; returns how many epochs were applied."""
+        self.poll()
+        before = self.epoch
+        idx = replay(self.searcher.mesh, self.searcher._index, self.log,
+                     to_epoch=to_epoch)
+        if int(idx.epoch) != before:
+            self.searcher.publish_index(idx)
+        return int(idx.epoch) - before
+
+
+class PromotionManager:
+    """Promote a follower when the primary's shard goes dead.
+
+    Subscribes to ``ShardHealth``'s transition listener
+    (``health.watch``): on the primary rank's live→dead edge the
+    follower catches up to the log head and its searcher flips
+    writable — recovery is replay-not-rebuild, served within one epoch
+    of the last committed mutation.  Promotion is idempotent (one
+    promotion per manager; dead ranks never auto-revive)."""
+
+    def __init__(self, follower: Follower, health, primary_rank: int):
+        self.follower = follower
+        self.health = health
+        self.primary_rank = primary_rank
+        self.promotions = 0
+        self.promoted = False
+        self._lock = threading.Lock()
+        self._unsub = health.watch(primary_rank, self.promote)
+
+    def promote(self) -> bool:
+        """Catch up + flip writable; returns False when already
+        promoted (the idempotent re-entry)."""
+        with self._lock:
+            if self.promoted:
+                return False
+            self.promoted = True
+        self.follower.catch_up()
+        self.follower.searcher.writable = True
+        self.promotions += 1
+        logger.warning("wal: follower promoted to primary (rank %s "
+                       "dead) at epoch %s", self.primary_rank,
+                       self.follower.epoch)
+        return True
+
+    def close(self) -> None:
+        self._unsub()

@@ -29,6 +29,7 @@ from raft_tpu.obs.registry import (
     CacheCollector,
     CompactorCollector,
     Counter,
+    ElasticCollector,
     Gauge,
     Histogram,
     MergeDispatchCollector,
@@ -37,6 +38,7 @@ from raft_tpu.obs.registry import (
     SearcherCollector,
     ServeStatsCollector,
     ShardHealthCollector,
+    WalCollector,
 )
 from raft_tpu.obs.trace import NULL_SPAN, NULL_TRACER, Span, Tracer
 
@@ -45,5 +47,6 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
-    "RoutingCollector", "RecallProbe",
+    "RoutingCollector", "WalCollector", "ElasticCollector",
+    "RecallProbe",
 ]

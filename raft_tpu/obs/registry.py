@@ -39,7 +39,7 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
-    "RoutingCollector",
+    "RoutingCollector", "WalCollector", "ElasticCollector",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -598,6 +598,95 @@ class RoutingCollector:
             self._shard_probes.set_total(n, shard=s)
         for s, n in snap["lists_owned"].items():
             self._lists_owned.set(n, shard=s)
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class WalCollector:
+    """Durability telemetry (lifecycle/wal.py): mutation-log append
+    volume, fsync latency histogram, snapshot count, replay lag per
+    follower and promotions fired — the counters that turn "did the
+    night's mutations survive?" into a scrapeable question.  Reads
+    host-side :class:`~raft_tpu.lifecycle.wal.WalStats` counters and
+    cached follower watermarks only; a scrape never touches log files
+    or device state (the fsync histogram drains latencies the log
+    accumulated at append time)."""
+
+    def __init__(self, registry: MetricsRegistry, stats,
+                 followers: Sequence = (), promotion=None,
+                 prefix: str = "raft_wal"):
+        self.stats = stats
+        self.followers = list(followers)
+        self.promotion = promotion
+        self._records = registry.counter(
+            prefix + "_records_total", "mutation records appended")
+        self._bytes = registry.counter(
+            prefix + "_bytes_total", "mutation-log bytes appended")
+        self._fsync = registry.histogram(
+            prefix + "_fsync_seconds", "log append fsync latency")
+        self._snapshots = registry.counter(
+            prefix + "_snapshots_total", "full index snapshots written")
+        self._head = registry.gauge(
+            prefix + "_head_epoch", "newest committed epoch in the log")
+        self._snap_epoch = registry.gauge(
+            prefix + "_snapshot_epoch", "epoch of the newest snapshot")
+        self._lag = registry.gauge(
+            prefix + "_replay_lag_epochs",
+            "epochs a follower trails the log head (as of its last "
+            "catch-up/poll)", labels=("follower",))
+        self._promotions = registry.counter(
+            prefix + "_promotions_total",
+            "followers promoted to primary")
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        st = self.stats
+        self._records.set_total(st.records)
+        self._bytes.set_total(st.bytes)
+        self._snapshots.set_total(st.snapshots)
+        self._head.set(st.head_epoch)
+        self._snap_epoch.set(st.last_snapshot_epoch)
+        for s in st.drain_fsyncs():
+            self._fsync.observe(s)
+        for i, f in enumerate(self.followers):
+            self._lag.set(f.lag, follower=i)
+        if self.promotion is not None:
+            self._promotions.set_total(self.promotion.promotions)
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class ElasticCollector:
+    """Elastic-membership telemetry (lifecycle/elastic.py
+    ``elastic_stats``): join/leave migrations completed, lists moved
+    across resizes, and the epoch of the last cutover."""
+
+    def __init__(self, registry: MetricsRegistry, stats=None,
+                 prefix: str = "raft_elastic"):
+        if stats is None:
+            from raft_tpu.lifecycle.elastic import elastic_stats
+            stats = elastic_stats
+        self.stats = stats
+        self._joins = registry.counter(
+            prefix + "_joins_total", "shards joined the serving set")
+        self._leaves = registry.counter(
+            prefix + "_leaves_total", "shards drained from the serving "
+            "set")
+        self._moved = registry.counter(
+            prefix + "_lists_moved_total",
+            "whole lists migrated by elastic resizes")
+        self._epoch = registry.gauge(
+            prefix + "_last_epoch", "epoch of the last resize cutover")
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        snap = self.stats.snapshot()
+        self._joins.set_total(snap["joins"])
+        self._leaves.set_total(snap["leaves"])
+        self._moved.set_total(snap["lists_moved"])
+        self._epoch.set(snap["last_epoch"])
 
     def close(self) -> None:
         self._unsub()

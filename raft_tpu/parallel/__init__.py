@@ -27,6 +27,7 @@ from raft_tpu.parallel.ivf import (
     sharded_migrate_lists,
     sharded_replicate_lists,
     sharded_routed_warmup,
+    verify_sharded_manifest,
 )
 from raft_tpu.parallel.routing import (
     ListPlacement,
@@ -47,7 +48,7 @@ __all__ = [
     "sharded_ivf_flat_build", "sharded_ivf_flat_search",
     "sharded_ivf_pq_build", "sharded_ivf_pq_search",
     "sharded_ivf_flat_extend", "sharded_ivf_pq_extend",
-    "sharded_ivf_save", "sharded_ivf_load",
+    "sharded_ivf_save", "sharded_ivf_load", "verify_sharded_manifest",
     "sharded_migrate_lists", "sharded_replicate_lists",
     "sharded_routed_warmup",
     "ListPlacement", "RoutePlan", "RoutingStats", "assign_lists",

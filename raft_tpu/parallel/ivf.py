@@ -71,6 +71,7 @@ from raft_tpu.parallel.routing import (
     route_shapes,
     routing_stats,
 )
+from raft_tpu.util.atomic_io import DEFAULT_IO, FileIO, atomic_savez
 from raft_tpu.util.pow2 import ceildiv, next_pow2
 from raft_tpu.util.shard_map_compat import shard_map
 
@@ -1592,12 +1593,38 @@ def sharded_replicate_lists(mesh: Mesh, index, list_ids,
 SHARDED_SERIALIZATION_VERSION = 1
 
 
-def sharded_ivf_save(basename: str, index) -> None:
-    """Persist a sharded index: one ``<base>.model.npz`` with the
-    replicated model + metadata, and ``<base>.shard{i}.npz`` per shard —
-    the per-rank layout of the reference's MNMG serializers
-    (detail/ivf_pq_serialize.cuh:38). Works for ShardedIvfFlat and
-    ShardedIvfPq."""
+def _manifest_path(basename: str) -> str:
+    return f"{basename}.manifest.npz"
+
+
+def sharded_ivf_save(basename: str, index, *, retry=None,
+                     file_io: FileIO = DEFAULT_IO) -> None:
+    """Persist a sharded index CRASH-SAFELY: one ``<base>.model.npz``
+    with the replicated model + metadata, ``<base>.shard{i}.npz`` per
+    shard — the per-rank layout of the reference's MNMG serializers
+    (detail/ivf_pq_serialize.cuh:38) — and a ``<base>.manifest.npz``
+    written LAST.  Works for ShardedIvfFlat and ShardedIvfPq.
+
+    Every file goes to disk via tmp+fsync+rename (util/atomic_io.py),
+    and the manifest (file list + sizes + CRC32s + the index epoch) is
+    the publish point: a kill at ANY byte of the save leaves either the
+    complete previous snapshot or a manifest that fails verification —
+    ``sharded_ivf_load`` can never half-load a torn file set.  ``retry``
+    (a :class:`~raft_tpu.core.retry.RetryPolicy`) retries each file
+    write on transient ``OSError``; ``file_io`` is the chaos seam
+    (``ChaosMonkey.wrap_write`` / ``wrap_rename``).
+
+    Multi-process meshes: each process writes its own shards; process 0
+    writes the model and the manifest with CRCs for its LOCAL files and
+    ``-1`` (unverifiable, existence-checked only) for remote shards —
+    the single-process layout gets full CRC coverage."""
+    from raft_tpu.core.retry import with_retry
+
+    def write(path, payload):
+        fn = lambda: atomic_savez(path, file_io, **payload)  # noqa: E731
+        meta = with_retry(fn, retry) if retry is not None else fn()
+        return meta
+
     is_pq = isinstance(index, ShardedIvfPq)
     model = dict(
         version=np.int64(SHARDED_SERIALIZATION_VERSION),
@@ -1628,8 +1655,13 @@ def sharded_ivf_save(basename: str, index) -> None:
         )
     # The replicated model is identical on every process — only process 0
     # writes it, or N processes would race on the same file path.
+    import os as _os
+
+    written = {}                       # file name -> (crc, size)
     if jax.process_index() == 0:
-        np.savez(f"{basename}.model.npz", **model)
+        meta = write(f"{basename}.model.npz", model)
+        written[_os.path.basename(f"{basename}.model.npz")] = \
+            (meta["crc"], meta["size"])
     store = index.pq_codes if is_pq else index.data
 
     # Each process writes only the shards it can address: on a
@@ -1662,15 +1694,88 @@ def sharded_ivf_save(basename: str, index) -> None:
     dels = by_start(index.deleted) if index.n_deleted else None
     for s, payload in stores.items():
         extra = {} if dels is None else {"deleted": dels[s]}
-        np.savez(f"{basename}.shard{s}.npz", store=payload,
-                 indices=ids[s], list_sizes=sizes[s], **extra)
+        path = f"{basename}.shard{s}.npz"
+        meta = write(path, dict(store=payload, indices=ids[s],
+                                list_sizes=sizes[s], **extra))
+        written[_os.path.basename(path)] = (meta["crc"], meta["size"])
+    if jax.process_index() == 0:
+        # Manifest LAST — the snapshot's commit point.  Every expected
+        # file is listed (existence-checked at load); files written by
+        # THIS process additionally carry their CRC32 + size.
+        n_shards = int(index.indices.shape[0])
+        names = [_os.path.basename(f"{basename}.model.npz")] + [
+            _os.path.basename(f"{basename}.shard{s}.npz")
+            for s in range(n_shards)]
+        crcs = np.array([written.get(n, (-1, -1))[0] for n in names],
+                        np.int64)
+        lens = np.array([written.get(n, (-1, -1))[1] for n in names],
+                        np.int64)
+        write(_manifest_path(basename), dict(
+            version=np.int64(SHARDED_SERIALIZATION_VERSION),
+            n_shards=np.int64(n_shards),
+            epoch=np.int64(index.epoch),
+            files=np.array(names), crc=crcs, size=lens))
 
 
-def sharded_ivf_load(mesh: Mesh, basename: str):
+def verify_sharded_manifest(basename: str) -> Optional[int]:
+    """Verify a snapshot's manifest against the files on disk; returns
+    the manifest's saved epoch, or None when no manifest exists (a
+    legacy pre-manifest save — loadable, but without torn-set
+    detection beyond file existence).  Raises loudly on ANY mismatch
+    (missing file, size drift, CRC drift): a torn snapshot must fail
+    here, before a single tensor is placed — never half-load."""
+    import os as _os
+
+    mpath = _manifest_path(basename)
+    if not _os.path.exists(mpath):
+        return None
+    with np.load(mpath) as m:
+        version = int(m["version"])
+        expects(version == SHARDED_SERIALIZATION_VERSION,
+                f"sharded manifest version mismatch: {version}")
+        names = [str(n) for n in m["files"]]
+        crcs = m["crc"].astype(np.int64)
+        lens = m["size"].astype(np.int64)
+        epoch = int(m["epoch"])
+    base_dir = _os.path.dirname(basename)
+    from raft_tpu.util.atomic_io import crc32 as _crc32
+
+    for name, crc, size in zip(names, crcs, lens):
+        path = _os.path.join(base_dir, name)
+        expects(_os.path.exists(path),
+                "torn snapshot %r: manifest lists %r but the file is "
+                "missing (kill mid-save?)", basename, name)
+        if crc < 0:
+            continue                   # written by another process
+        data = open(path, "rb").read()
+        expects(len(data) == int(size),
+                "torn snapshot %r: %r is %s bytes, manifest says %s",
+                basename, name, len(data), int(size))
+        expects(_crc32(data) == int(crc),
+                "torn snapshot %r: %r fails its manifest CRC — file "
+                "content does not match what the save committed",
+                basename, name)
+    return epoch
+
+
+def sharded_ivf_load(mesh: Mesh, basename: str, *, retry=None):
     """Load a sharded index saved by :func:`sharded_ivf_save`, re-placing
     the shard tensors over ``mesh`` (the shard count must match the mesh
-    axis size, like rank-count-pinned MNMG deserialization)."""
-    with np.load(f"{basename}.model.npz") as m:
+    axis size, like rank-count-pinned MNMG deserialization).
+
+    When the save left a manifest, the WHOLE file set is verified
+    (existence + size + CRC32) before any tensor is placed — a torn
+    snapshot raises here instead of half-loading.  Legacy manifest-less
+    saves still load, with an up-front existence check for every shard
+    file.  ``retry`` retries each file read on transient ``OSError``."""
+    from raft_tpu.core.retry import with_retry
+
+    def load_npz(path):
+        fn = lambda: np.load(path)  # noqa: E731
+        return with_retry(fn, retry) if retry is not None else fn()
+
+    verify_sharded_manifest(basename)
+    with load_npz(f"{basename}.model.npz") as m:
         version = int(m["version"])
         expects(version == SHARDED_SERIALIZATION_VERSION,
                 f"sharded serialization version mismatch: {version}")
@@ -1681,8 +1786,15 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
                 f"index has {n_shards} shards but mesh[{axis!r}] = "
                 f"{mesh.shape[axis]}")
         model = {k: m[k] for k in m.files}
+    # Legacy manifest-less saves: fail fast on a missing shard file up
+    # front instead of deep inside the placement callback.
+    import os as _os
+    for s in range(n_shards):
+        expects(_os.path.exists(f"{basename}.shard{s}.npz"),
+                "sharded snapshot %r is missing shard file %d/%d "
+                "(torn save?)", basename, s, n_shards)
     sharding = NamedSharding(mesh, P(axis))
-    with np.load(f"{basename}.shard0.npz") as z0:
+    with load_npz(f"{basename}.shard0.npz") as z0:
         keys = ["store", "indices", "list_sizes"]
         if "deleted" in z0.files:
             keys.append("deleted")
@@ -1699,7 +1811,7 @@ def sharded_ivf_load(mesh: Mesh, basename: str):
 
     def shard_arrays(s: int):
         if s not in shard_cache:
-            with np.load(f"{basename}.shard{s}.npz") as z:
+            with load_npz(f"{basename}.shard{s}.npz") as z:
                 shard_cache[s] = {k: z[k] for k in keys}
         return shard_cache[s]
 

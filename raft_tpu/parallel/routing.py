@@ -110,7 +110,8 @@ class ListPlacement:
                         self.replica_slot).astype(np.int32)
 
 
-def assign_lists(weights, n_dev: int, centers=None) -> np.ndarray:
+def assign_lists(weights, n_dev: int, centers=None,
+                 active=None) -> np.ndarray:
     """Size-balanced bin packing of whole lists onto shards.
 
     Without ``centers``: LPT greedy — lists in descending weight order,
@@ -128,9 +129,24 @@ def assign_lists(weights, n_dev: int, centers=None) -> np.ndarray:
     clustered query's probes concentrate on one or two shards instead
     of scattering size-balanced across all of them (the fan-out /
     exchange-bytes win the routed placement exists for).  Deterministic
-    (power iteration from a fixed start; stable sorts)."""
+    (power iteration from a fixed start; stable sorts).
+
+    ``active`` restricts the packing to a subset of shard ids (owners
+    are drawn only from it; the returned array still indexes the full
+    ``n_dev`` id space) — how elastic join/leave
+    (``lifecycle.elastic``) packs onto the post-resize serving set
+    while the mesh shape stays fixed."""
     w = np.asarray(weights, np.float64).reshape(-1)
     expects(n_dev >= 1, "need at least one shard, got %s", n_dev)
+    if active is not None:
+        ranks = np.asarray(sorted(int(s) for s in active), np.int32)
+        expects(ranks.size >= 1, "active shard set must be non-empty")
+        expects(ranks.size == np.unique(ranks).size
+                and ranks[0] >= 0 and ranks[-1] < n_dev,
+                "active shards must be unique ids in [0, %s), got %s",
+                n_dev, ranks.tolist())
+        sub = assign_lists(w, int(ranks.size), centers=centers)
+        return ranks[sub]
     if centers is None:
         owner = np.zeros(w.shape[0], np.int32)
         loads = np.zeros(n_dev, np.float64)

@@ -173,6 +173,23 @@ class BatchScheduler:
         self._unhook = (searcher.add_invalidation_hook(cache.invalidate)
                         if cache is not None else None)
 
+    def replace_searcher(self, searcher: Searcher) -> Searcher:
+        """Swap the serving endpoint (follower promotion: the promoted
+        follower's searcher takes over request traffic).  The cache
+        invalidation hook moves to the new searcher and the cache is
+        invalidated outright — the endpoints may disagree on epoch
+        numbering, so entries keyed against the old one must not answer
+        for the new one.  Returns the retired searcher."""
+        with self._lock:
+            old, self.searcher = self.searcher, searcher
+            if self._unhook is not None:
+                self._unhook()
+                self._unhook = searcher.add_invalidation_hook(
+                    self.cache.invalidate)
+        if self.cache is not None:
+            self.cache.invalidate()
+        return old
+
     # -- admission ---------------------------------------------------------
     def submit(self, queries, k: int,
                deadline: Optional[float] = None) -> Ticket:

@@ -31,6 +31,14 @@ copy-on-write successor index by swapping one reference — in-flight
 batches keep searching their dispatch-time snapshot.  Mutations
 serialize on an internal lock; searches never take it (they read one
 index reference, and every published state is internally consistent).
+
+Durability (raft_tpu/lifecycle/wal.py, docs/durability.md): with a
+``wal`` attached, every mutation appends its record — fsynced — BEFORE
+the serving reference swaps (write-ahead order: a record exists iff
+the epoch it stamps was ever observable), and publishes trigger the
+log's snapshot cadence.  ``writable=False`` builds a read-only
+follower endpoint: searches serve, mutations raise until a
+``PromotionManager`` flips the flag.
 """
 
 from __future__ import annotations
@@ -76,6 +84,7 @@ class Searcher:
     def __init__(self, kind: str, *, mesh=None, db=None, index=None,
                  search_params=None, merge_engine: str = "auto",
                  health=None, retry: Optional[RetryPolicy] = None,
+                 wal=None, writable: bool = True,
                  sleep: Callable[[float], None] = time.sleep,
                  monotonic: Callable[[], float] = time.monotonic):
         expects(kind in _KINDS, "kind must be one of %s, got %r", _KINDS,
@@ -87,11 +96,17 @@ class Searcher:
                     "IVF searchers need index + search_params")
         expects(health is None or mesh is not None,
                 "ShardHealth only applies to sharded (mesh) searchers")
+        expects(wal is None or (mesh is not None
+                                and kind != "brute_force"),
+                "a MutationLog records sharded IVF mutations (brute-"
+                "force rows are positional — nothing stable to replay)")
         self.kind = kind
         self.mesh = mesh
         self.merge_engine = merge_engine
         self.health = health
         self.retry = retry
+        self.wal = wal
+        self.writable = writable
         self._sleep = sleep
         self._monotonic = monotonic
         self._index = index
@@ -173,6 +188,53 @@ class Searcher:
             hooks = list(self._invalidation_hooks)
         for hook in hooks:
             hook()
+
+    # -- durability --------------------------------------------------------
+    def _require_writable(self) -> None:
+        expects(self.writable,
+                "read-only follower endpoint — mutations are rejected "
+                "until promotion (lifecycle.wal.PromotionManager)")
+
+    def _wal_append(self, kind: str, new_index, payload: dict) -> None:
+        """Durably log one mutation at its POST-mutation epoch.  Called
+        with the successor built but not yet published — the write-
+        ahead order: a crash after the append replays the mutation
+        (redo), a crash before it loses a mutation no reader ever saw."""
+        if self.wal is not None:
+            self.wal.append(kind, int(new_index.epoch), payload)
+
+    def _published(self) -> None:
+        """Post-publish duties: invalidation hooks (outside the lock),
+        then the log's snapshot cadence (a snapshot rides the epoch the
+        swap just committed)."""
+        self._fire_hooks()
+        if self.wal is not None:
+            self.wal.maybe_snapshot(self._index, self.mesh)
+
+    def publish_index(self, new_index, *, record=None,
+                      expect_base_epoch: Optional[int] = None) -> None:
+        """Publish an externally built copy-on-write successor under
+        the snapshot-swap contract (elastic join/leave cutover,
+        follower catch-up).  ``record=(kind, payload)`` logs the
+        mutation write-ahead; ``expect_base_epoch`` asserts no
+        concurrent mutation slipped in while the successor was being
+        built (the elastic warmup window) instead of silently dropping
+        it."""
+        with self._lock:
+            cur = int(getattr(self._index, "epoch", 0))
+            if expect_base_epoch is not None:
+                expects(cur == expect_base_epoch,
+                        "concurrent mutation during publish: index "
+                        "moved %s -> %s while the successor was built",
+                        expect_base_epoch, cur)
+            expects(int(new_index.epoch) > cur,
+                    "publish must advance the epoch (%s -> %s)", cur,
+                    int(new_index.epoch))
+            if record is not None:
+                kind, payload = record
+                self._wal_append(kind, new_index, payload)
+            self._index = new_index
+        self._published()
 
     # -- serving -----------------------------------------------------------
     def _resolve_live(self, degraded: Optional[bool]):
@@ -354,9 +416,10 @@ class Searcher:
         Sharded endpoints keep the build-time contract: TOTAL rows after
         the extend must divide the mesh axis (pad the increment upstream
         — zero-row padding would otherwise surface as fake neighbors)."""
+        self._require_writable()
         with self._lock:
             self._extend_locked(new_vectors, new_indices)
-        self._fire_hooks()
+        self._published()
 
     def _mutable_snapshot(self):
         """Shallow copy of the served index for a mutate-then-swap
@@ -402,7 +465,23 @@ class Searcher:
             # against the current buffers — donation would invalidate
             # them mid-flight.
             tmp = self._mutable_snapshot()
+            if self.wal is not None and new_indices is None:
+                # Pin auto-assigned ids explicitly so the record holds
+                # the EXACT ids this extend assigns — replay after a
+                # compact (which drops tombstoned ids and can lower
+                # the stored max) would otherwise re-derive different
+                # auto ids than the live run's tracker handed out.
+                from raft_tpu.neighbors.ivf_flat import _auto_id_base
+
+                base = _auto_id_base(tmp)
+                n_new = int(np.asarray(new_vectors).shape[0])
+                new_indices = np.arange(base, base + n_new,
+                                        dtype=tmp.indices.dtype)
             fn(self.mesh, tmp, new_vectors, new_indices, donate=False)
+            if self.wal is not None:
+                self._wal_append("extend", tmp, dict(
+                    vectors=np.asarray(new_vectors),
+                    ids=np.asarray(new_indices)))
             self._index = tmp
         else:
             from raft_tpu.neighbors import ivf_flat, ivf_pq
@@ -426,15 +505,20 @@ class Searcher:
         expects(self.kind != "brute_force",
                 "delete needs an IVF index (brute-force rows are "
                 "positional; rebuild the endpoint instead)")
+        self._require_writable()
         from raft_tpu.lifecycle import delete as _delete
 
         with self._lock:
             tmp = self._mutable_snapshot()
             n = _delete(tmp, ids, mesh=self.mesh)
             if n:
+                # Log only committed deletes — an all-miss delete bumps
+                # no epoch, so a record for it could never replay.
+                self._wal_append("delete", tmp,
+                                 dict(ids=np.asarray(ids)))
                 self._index = tmp     # snapshot-swap publish
         if n:
-            self._fire_hooks()
+            self._published()
         return n
 
     def upsert(self, new_vectors, new_indices) -> None:
@@ -444,6 +528,7 @@ class Searcher:
         expects(self.kind != "brute_force",
                 "upsert needs an IVF index (brute-force rows are "
                 "positional; rebuild the endpoint instead)")
+        self._require_writable()
         from raft_tpu.lifecycle import upsert as _upsert
 
         with self._lock:
@@ -451,8 +536,11 @@ class Searcher:
             tmp = self._mutable_snapshot()
             _upsert(tmp, new_vectors, new_indices, mesh=self.mesh,
                     donate=False)
+            self._wal_append("upsert", tmp, dict(
+                vectors=np.asarray(new_vectors),
+                ids=np.asarray(new_indices)))
             self._index = tmp
-        self._fire_hooks()
+        self._published()
 
     def compact(self, policy=None, pre_publish=None):
         """Run one compaction pass (raft_tpu/lifecycle/compact.py) and
@@ -467,21 +555,40 @@ class Searcher:
         expects(self.kind != "brute_force",
                 "compact applies to IVF indexes (brute-force holds no "
                 "tombstones)")
+        self._require_writable()
+        from raft_tpu.lifecycle import CompactionPolicy
         from raft_tpu.lifecycle import compact as _compact
 
+        policy = policy or CompactionPolicy()
         with self._lock:
             # Liveness gates the placement balancer (a re-balance must
             # not assign lists onto a dead shard) — see compact().
-            new, report = _compact(
-                self._index, policy, mesh=self.mesh,
-                live_mask=(self.health.live_mask
-                           if self.health is not None else None))
+            live = (self.health.live_mask
+                    if self.health is not None else None)
+            new, report = _compact(self._index, policy, mesh=self.mesh,
+                                   live_mask=live)
             if report is None:
                 return None
             if pre_publish is not None:
                 pre_publish()
+            if self.wal is not None:
+                from raft_tpu.lifecycle.wal import _policy_payload
+
+                payload = _policy_payload(policy)
+                old_pm = getattr(self._index, "placement_map", None)
+                new_pm = getattr(new, "placement_map", None)
+                if new_pm is not None and new_pm is not old_pm:
+                    # The pass balanced the placement off process-local
+                    # routing_stats traffic — record the OUTCOME so
+                    # replay migrates to it instead of re-deriving from
+                    # traffic it no longer has.
+                    payload["owner"] = np.asarray(new_pm.owner, np.int32)
+                    payload["live"] = (np.asarray(live, bool)
+                                       if live is not None else
+                                       np.ones(new_pm.n_dev, bool))
+                self._wal_append("compact", new, payload)
             self._index = new
-        self._fire_hooks()
+        self._published()
         return report
 
     @property

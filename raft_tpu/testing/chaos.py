@@ -9,7 +9,7 @@ the repo: wrap an eager call site, script faults at exact call indexes,
 and the failure sequence replays bit-for-bit on every run — no
 wall-clock, no unseeded randomness.
 
-Three fault kinds (the failure modes of the sharded serving story):
+Five fault kinds (the failure modes of the sharded serving story):
 
 * ``"raise"``   — the call site raises :class:`InjectedFault` (or a
   caller-supplied exception factory) — a lost transfer / IO error.
@@ -19,6 +19,14 @@ Three fault kinds (the failure modes of the sharded serving story):
 * ``"drop_rank"`` — a scripted rank is marked dead in a
   :class:`~raft_tpu.comms.health.ShardHealth` registry — a host loss,
   feeding the degraded-serving path.
+* ``"torn_write"`` — a :meth:`ChaosMonkey.wrap_write` byte-write site
+  writes only the first ``offset`` bytes of its payload, then raises —
+  the on-disk state a power loss mid-``write(2)`` leaves behind
+  (util/atomic_io.py write seam; lifecycle/wal.py log appends).
+* ``"partial_rename"`` — a :meth:`ChaosMonkey.wrap_rename` rename site
+  raises WITHOUT renaming, leaving the ``.tmp`` file orphaned — a kill
+  between a multi-file save's renames (some files published, some not;
+  the torn-snapshot state the manifest check must catch).
 
 Usage::
 
@@ -55,19 +63,27 @@ class FaultSpec:
     ``rank`` names the victim for ``"drop_rank"``; ``error`` overrides
     the raised exception factory for ``"raise"`` (a callable returning
     an exception instance, so each attempt gets a fresh object and
-    retry cause-chains stay acyclic).
+    retry cause-chains stay acyclic); ``offset`` is the byte offset a
+    ``"torn_write"`` truncates the payload at (clamped to the payload
+    length; 0 = nothing written before the tear).
     """
 
-    kind: str = "raise"                 # "raise" | "corrupt" | "drop_rank"
+    kind: str = "raise"   # "raise" | "corrupt" | "drop_rank"
+    #                     # | "torn_write" | "partial_rename"
     at: Tuple[int, ...] = (0,)
     rank: int = -1
     error: Optional[Callable[[], BaseException]] = None
+    offset: int = -1
 
     def __post_init__(self):
-        expects(self.kind in ("raise", "corrupt", "drop_rank"),
+        expects(self.kind in ("raise", "corrupt", "drop_rank",
+                              "torn_write", "partial_rename"),
                 "unknown fault kind %r", self.kind)
         if self.kind == "drop_rank":
             expects(self.rank >= 0, "drop_rank needs a victim rank")
+        if self.kind == "torn_write":
+            expects(self.offset >= 0,
+                    "torn_write needs the byte offset to tear at")
 
 
 @dataclass
@@ -115,6 +131,11 @@ class ChaosMonkey:
             idx = state.calls
             state.calls += 1
             fault = self._fault_at(state, idx)
+            expects(fault is None or fault.kind not in
+                    ("torn_write", "partial_rename"),
+                    "%r faults need the typed IO wrappers (wrap_write / "
+                    "wrap_rename) — a generic call site has no byte "
+                    "payload to tear", fault.kind if fault else "")
             if fault is not None and fault.kind == "drop_rank":
                 expects(self.health is not None,
                         "drop_rank fault needs ChaosMonkey(health=...)")
@@ -130,6 +151,72 @@ class ChaosMonkey:
             return out
 
         return chaotic
+
+    def wrap_write(self, site: str, fn: Optional[Callable] = None,
+                   faults: Optional[Sequence[FaultSpec]] = None
+                   ) -> Callable:
+        """Wrap a ``write_bytes(f, data)``-shaped primitive (the
+        :class:`raft_tpu.util.atomic_io.FileIO` seam) as chaos site
+        ``site``.  ``"torn_write"`` faults write ``data[:offset]``
+        through the real primitive and then raise — the file holds a
+        true prefix of the payload, exactly the state a power loss
+        mid-write leaves.  ``"raise"`` faults pre-empt the write
+        entirely.  Deterministic and replayable like :meth:`wrap`."""
+        from raft_tpu.util import atomic_io
+
+        real = fn if fn is not None else atomic_io.DEFAULT_IO.write_bytes
+        if faults:
+            self.script(site, faults)
+        state = self._sites.setdefault(site, _Site())
+
+        def chaotic_write(f, data):
+            idx = state.calls
+            state.calls += 1
+            fault = self._fault_at(state, idx)
+            if fault is not None and fault.kind == "torn_write":
+                real(f, bytes(data)[:fault.offset])
+                f.flush()
+                raise InjectedFault(
+                    f"torn write at {site}[{idx}]: "
+                    f"{min(fault.offset, len(data))}/{len(data)} bytes")
+            if fault is not None and fault.kind == "raise":
+                raise (fault.error() if fault.error is not None
+                       else InjectedFault(
+                           f"injected fault at {site}[{idx}]"))
+            return real(f, data)
+
+        return chaotic_write
+
+    def wrap_rename(self, site: str, fn: Optional[Callable] = None,
+                    faults: Optional[Sequence[FaultSpec]] = None
+                    ) -> Callable:
+        """Wrap a ``replace(src, dst)``-shaped primitive as chaos site
+        ``site``.  ``"partial_rename"`` faults raise WITHOUT renaming
+        (the ``.tmp`` stays orphaned, ``dst`` keeps its old content or
+        stays absent) — the torn state of a kill between a multi-file
+        publish's renames.  ``"raise"`` behaves identically here (the
+        rename never happened) but keeps the generic retryable-error
+        semantics."""
+        import os as _os
+
+        real = fn if fn is not None else _os.replace
+        if faults:
+            self.script(site, faults)
+        state = self._sites.setdefault(site, _Site())
+
+        def chaotic_rename(src, dst):
+            idx = state.calls
+            state.calls += 1
+            fault = self._fault_at(state, idx)
+            if fault is not None and fault.kind in ("partial_rename",
+                                                    "raise"):
+                raise (fault.error() if fault.error is not None
+                       else InjectedFault(
+                           f"injected {fault.kind} at {site}[{idx}]: "
+                           f"{src} -> {dst} dropped"))
+            return real(src, dst)
+
+        return chaotic_rename
 
     def hook(self, site: str) -> Callable[[], None]:
         """A zero-arg callable that :meth:`fire`\\ s ``site`` — the shape

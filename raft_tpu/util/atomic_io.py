@@ -1,0 +1,93 @@
+"""Atomic host-file I/O seam: tmp+rename writes with CRC32 framing.
+
+Ref: the reference serializes indexes through a buffered ``serializer``
+(cpp/include/raft/core/serialize.hpp) straight onto the target path — a
+kill mid-write leaves a torn file the next load half-reads.  Every
+durable artifact in this repo (WAL segments, sharded snapshot files,
+manifests — raft_tpu/lifecycle/wal.py, parallel/ivf.py) goes through
+this seam instead: write the full payload to ``<path>.tmp``, fsync,
+then ``os.replace`` onto the final name — POSIX rename atomicity makes
+"the file exists" equivalent to "the file is complete".
+
+The primitive operations (``write_bytes`` / ``replace`` / ``fsync``)
+are injectable so the chaos harness (testing/chaos.py ``wrap_write`` /
+``wrap_rename``) can tear a payload at a scripted byte offset or drop a
+rename, deterministically, without monkey-patching ``os``.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict
+
+import numpy as np
+
+
+def _default_write(f, data: bytes) -> None:
+    f.write(data)
+
+
+def _default_fsync(f) -> None:
+    f.flush()
+    os.fsync(f.fileno())
+
+
+@dataclass(frozen=True)
+class FileIO:
+    """The injectable file-primitive bundle.  Defaults are the real
+    operations; chaos tests substitute wrapped ones (a ``torn_write``
+    truncates the payload mid-write, a ``partial_rename`` drops the
+    rename — exactly the states a power loss leaves behind)."""
+
+    write_bytes: Callable[[Any, bytes], None] = field(
+        default=_default_write)
+    replace: Callable[[str, str], None] = field(default=os.replace)
+    fsync: Callable[[Any], None] = field(default=_default_fsync)
+
+
+#: Shared default instance (no injected faults).
+DEFAULT_IO = FileIO()
+
+
+def crc32(data: bytes) -> int:
+    """Unsigned CRC32 (zlib) — the integrity check framing WAL records
+    and snapshot manifest entries."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def savez_bytes(**arrays) -> bytes:
+    """``np.savez`` into memory — the serialized payload is hashed and
+    written through :func:`atomic_write_bytes` as one unit, so a file's
+    CRC can be recorded before it ever touches disk."""
+    buf = _io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def atomic_write_bytes(path: str, data: bytes,
+                       file_io: FileIO = DEFAULT_IO,
+                       fsync: bool = True) -> int:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + rename).
+    Returns the CRC32 of the payload.  A crash at ANY point leaves
+    either the complete new file, the complete old file, or a stale
+    ``.tmp`` the next write overwrites — never a torn ``path``."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        file_io.write_bytes(f, data)
+        if fsync:
+            file_io.fsync(f)
+    file_io.replace(tmp, path)
+    return crc32(data)
+
+
+def atomic_savez(path: str, file_io: FileIO = DEFAULT_IO,
+                 fsync: bool = True, **arrays) -> Dict[str, int]:
+    """Atomic ``np.savez``: serialize to memory, write via
+    :func:`atomic_write_bytes`.  Returns ``{"crc": ..., "size": ...}``
+    for the caller's manifest entry."""
+    data = savez_bytes(**arrays)
+    return {"crc": atomic_write_bytes(path, data, file_io, fsync=fsync),
+            "size": len(data)}

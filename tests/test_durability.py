@@ -1,0 +1,667 @@
+"""Durable mutation log (raft_tpu/lifecycle/wal.py) acceptance suite.
+
+The ISSUE-17 contracts: (a) every committed mutation appends ONE
+CRC-framed, epoch-stamped record BEFORE the serving reference swaps, so
+a kill at ANY point recovers to a complete epoch — pre-append kills
+roll back (the mutation was never observed), post-append kills redo
+(replay re-applies the committed record), torn appends truncate back to
+the last clean frame; (b) ``recover`` = newest verifiable snapshot +
+log-tail replay, bit-identical (ids + distances + epoch) to the
+uninterrupted run at the same epoch, across flat/PQ and row/list
+placement; (c) a read-only ``Follower`` tails the log and a primary
+death promotes it — caught up to the log head, zero lost committed
+mutations, mutations rejected until the flip; (d) torn SEALED segments
+are loud corruption, torn OPEN tails are tolerated and repaired.
+
+The kill-point grid runs the in-tier slice on (flat, list placement);
+the full kind x placement grid rides the ``slow`` lane.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.core.error import LogicError
+from raft_tpu.lifecycle import (
+    CompactionPolicy,
+    Follower,
+    MutationLog,
+    PromotionManager,
+    WalCorruption,
+    recover,
+    replay,
+)
+from raft_tpu.lifecycle.wal import (
+    _HEADER,
+    LogWriter,
+    WalStats,
+    decode_records,
+    encode_record,
+)
+from raft_tpu.comms import ShardHealth
+from raft_tpu.neighbors import ivf_flat, ivf_pq
+from raft_tpu.parallel.ivf import (
+    sharded_ivf_flat_build,
+    sharded_ivf_flat_search,
+    sharded_ivf_pq_build,
+    sharded_ivf_pq_search,
+)
+from raft_tpu.serve import Searcher
+from raft_tpu.testing.chaos import ChaosMonkey, FaultSpec, InjectedFault
+from raft_tpu.util.atomic_io import FileIO
+
+pytestmark = pytest.mark.chaos
+
+N_DEV = 4
+N_PARTS = 2
+K = 10
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices())
+    assert devs.size >= N_DEV
+    return Mesh(devs[:N_DEV], ("data",))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compile_cache():
+    # The kill grid compiles many mutation/search variants; freeing the
+    # executables when the module ends keeps the single-process tier-1
+    # run's peak RSS where it was before this file existed.
+    yield
+    jax.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# Record codec
+
+
+def _arrays(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    return dict(vectors=rng.normal(size=(n, 8)).astype(np.float32),
+                ids=np.arange(n, dtype=np.int32))
+
+
+class TestRecordCodec:
+    def test_roundtrip_all_kinds(self):
+        stream = b""
+        for e, kind in enumerate(("extend", "delete", "upsert", "compact",
+                                  "migrate"), start=1):
+            stream += encode_record(kind, e, e - 1, _arrays(e))
+        recs, end = decode_records(stream)
+        assert end == len(stream)
+        assert [r.kind for r in recs] == ["extend", "delete", "upsert",
+                                          "compact", "migrate"]
+        assert [r.epoch for r in recs] == [1, 2, 3, 4, 5]
+        assert [r.seq for r in recs] == [0, 1, 2, 3, 4]
+        for e, r in enumerate(recs, start=1):
+            want = _arrays(e)
+            got = r.arrays
+            np.testing.assert_array_equal(got["vectors"], want["vectors"])
+            np.testing.assert_array_equal(got["ids"], want["ids"])
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(LogicError):
+            encode_record("rename", 1, 0, _arrays())
+
+    def test_truncation_at_every_sampled_offset(self):
+        """A stream cut at ANY byte yields exactly the records whose
+        full frame survived — never a partial record."""
+        r1 = encode_record("extend", 1, 0, _arrays(1))
+        r2 = encode_record("delete", 2, 1, _arrays(2))
+        stream = r1 + r2
+        offsets = sorted(set(
+            list(range(0, len(stream), 17))
+            + [len(r1) - 1, len(r1), len(r1) + 1, len(stream) - 1,
+               len(stream)]))
+        for cut in offsets:
+            recs, end = decode_records(stream[:cut])
+            want = 2 if cut >= len(stream) else (1 if cut >= len(r1)
+                                                 else 0)
+            assert len(recs) == want, f"cut at {cut}"
+            assert end == (len(r1) * want if want < 2 else len(stream))
+
+    def test_corrupt_payload_detected(self):
+        frame = bytearray(encode_record("extend", 1, 0, _arrays()))
+        frame[_HEADER.size + 5] ^= 0xFF
+        recs, end = decode_records(bytes(frame))
+        assert recs == [] and end == 0
+        with pytest.raises(WalCorruption, match="CRC"):
+            decode_records(bytes(frame), tolerate_tail=False)
+
+    def test_bad_magic_detected(self):
+        frame = b"JUNK" + encode_record("extend", 1, 0, _arrays())[4:]
+        with pytest.raises(WalCorruption, match="magic"):
+            decode_records(frame, tolerate_tail=False)
+
+
+# ---------------------------------------------------------------------------
+# Segment writer: torn tails repaired, sealed segments strict
+
+
+class TestLogWriter:
+    def test_torn_tail_repaired_on_reopen(self, tmp_path):
+        d = str(tmp_path / "part0")
+        w = LogWriter(d, fsync=False)
+        f1 = encode_record("extend", 1, 0, _arrays(1))
+        f2 = encode_record("delete", 2, 1, _arrays(2))
+        w.append(f1)
+        w.append(f2)
+        w.close()
+        # Power loss mid-append: a true prefix of a third frame.
+        f3 = encode_record("upsert", 3, 2, _arrays(3))
+        path = sorted(glob.glob(os.path.join(d, "seg-*.wal")))[-1]
+        with open(path, "ab") as f:
+            f.write(f3[:len(f3) // 2])
+        torn_size = os.path.getsize(path)
+        w = LogWriter(d, fsync=False)            # reopen repairs
+        assert os.path.getsize(path) == torn_size - len(f3) // 2
+        assert [r.epoch for r in w.read()] == [1, 2]
+        w.append(f3)                             # resumes appending
+        assert [r.epoch for r in w.read()] == [1, 2, 3]
+        w.close()
+
+    def test_rotation_seals_segments(self, tmp_path):
+        d = str(tmp_path / "part0")
+        w = LogWriter(d, fsync=False, segment_bytes=64)  # rotate per frame
+        for e in range(1, 6):
+            w.append(encode_record("extend", e, e - 1, _arrays(e)))
+        assert len(w.segments()) == 5
+        assert [r.epoch for r in w.read()] == [1, 2, 3, 4, 5]
+        w.close()
+
+    def test_torn_sealed_segment_is_loud(self, tmp_path):
+        d = str(tmp_path / "part0")
+        w = LogWriter(d, fsync=False, segment_bytes=64)
+        for e in range(1, 4):
+            w.append(encode_record("extend", e, e - 1, _arrays(e)))
+        w.close()
+        sealed = w.segments()[0]
+        with open(sealed, "r+b") as f:
+            f.truncate(os.path.getsize(sealed) - 7)
+        w = LogWriter(d, fsync=False, segment_bytes=64)
+        with pytest.raises(WalCorruption):
+            w.read()
+        w.close()
+
+
+# ---------------------------------------------------------------------------
+# MutationLog: multi-part order, resume, truncate
+
+
+class TestMutationLog:
+    def test_parts_merge_in_total_order(self, tmp_path):
+        log = MutationLog(str(tmp_path), n_parts=3, fsync=False)
+        for e in range(1, 10):
+            log.append("extend", e, _arrays(e, n=4))
+        recs = log.records()
+        assert [r.epoch for r in recs] == list(range(1, 10))
+        assert [r.seq for r in recs] == list(range(9))
+        # Round-robin actually spread the records.
+        assert all(
+            glob.glob(os.path.join(str(tmp_path), f"part{p}", "seg-*"))
+            for p in range(3))
+        log.close()
+
+    def test_reopen_resumes_seq_and_head(self, tmp_path):
+        log = MutationLog(str(tmp_path), n_parts=2, fsync=False)
+        for e in range(1, 4):
+            log.append("extend", e, _arrays(e, n=4))
+        log.close()
+        log = MutationLog(str(tmp_path), n_parts=2, fsync=False)
+        assert log.head_epoch() == 3
+        rec = log.append("delete", 4, _arrays(4, n=4))
+        assert rec.seq == 3                       # not reused
+        assert [r.epoch for r in log.records()] == [1, 2, 3, 4]
+        log.close()
+
+    def test_part_count_mismatch_rejected(self, tmp_path):
+        MutationLog(str(tmp_path), n_parts=2, fsync=False).close()
+        with pytest.raises(LogicError, match="parts"):
+            MutationLog(str(tmp_path), n_parts=3, fsync=False)
+
+    def test_truncate_drops_only_sealed_covered_segments(self, tmp_path):
+        log = MutationLog(str(tmp_path), n_parts=1, segment_bytes=64,
+                          fsync=False)
+        for e in range(1, 6):                     # one segment per record
+            log.append("extend", e, _arrays(e, n=4))
+        assert log.truncate(up_to_epoch=3) == 3
+        # Epochs 4, 5 survive (5 is the open segment either way).
+        assert [r.epoch for r in log.records()] == [4, 5]
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# Kill-at-every-point recovery grid
+
+
+STREAM_STEPS = ("extend", "delete", "upsert", "compact", "extend2")
+
+
+def _db(kind, n=1024):
+    dim = 32 if kind.startswith("pq") else 16
+    return np.random.default_rng(3).normal(size=(n, dim)).astype(
+        np.float32)
+
+
+def _build(mesh, kind):
+    db = _db(kind)
+    placement = "list" if kind.endswith("list") else "row"
+    if kind.startswith("flat"):
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        model = ivf_flat.build(ivf_flat.IndexParams(
+            n_lists=8, kmeans_n_iters=4, add_data_on_build=False), db)
+        index = sharded_ivf_flat_build(mesh, params, db,
+                                       centers=model.centers,
+                                       placement=placement)
+        sp = ivf_flat.SearchParams(n_probes=8)
+    else:
+        params = ivf_pq.IndexParams(n_lists=8, pq_dim=16,
+                                    kmeans_n_iters=4)
+        model = ivf_pq.build(ivf_pq.IndexParams(
+            n_lists=8, pq_dim=16, kmeans_n_iters=4,
+            add_data_on_build=False), db)
+        index = sharded_ivf_pq_build(mesh, params, db, model=model,
+                                     placement=placement)
+        sp = ivf_pq.SearchParams(n_probes=8)
+    return index, sp
+
+
+def _search(mesh, kind, sp, index):
+    q = _db(kind)[:16]
+    fn = (sharded_ivf_flat_search if kind.startswith("flat")
+          else sharded_ivf_pq_search)
+    d, i = fn(mesh, sp, index, q, K)
+    return np.asarray(d), np.asarray(i)
+
+
+def _steps(kind):
+    """The scripted mutation stream: one of each record kind (the
+    compact records the placement outcome under ``balance_placement``
+    on list-placement indexes)."""
+    dim = 32 if kind.startswith("pq") else 16
+    rng = np.random.default_rng(7)
+    ext1 = rng.normal(size=(128, dim)).astype(np.float32)
+    dels = np.arange(0, 1024, 10)
+    up_ids = np.arange(5, 325, 5)
+    up_vecs = rng.normal(size=(up_ids.size, dim)).astype(np.float32)
+    ext2 = rng.normal(size=(64, dim)).astype(np.float32)
+    policy = CompactionPolicy(trigger_frac=0.01, balance_placement=1.0)
+    return [
+        lambda s: s.extend(ext1),                 # auto ids, WAL-pinned
+        lambda s: s.delete(dels),
+        lambda s: s.upsert(up_vecs, up_ids),
+        lambda s: s.compact(policy),
+        lambda s: s.extend(ext2),
+    ]
+
+
+def _fresh_root(mesh, kind, root, n_parts=N_PARTS, **log_kwargs):
+    """A new log root seeded with an epoch-0 snapshot of the base
+    index; returns the (unmutated) base index + search params."""
+    index, sp = _build(mesh, kind)
+    log = MutationLog(root, n_parts=n_parts, fsync=False, **log_kwargs)
+    log.snapshot(index, mesh)
+    log.close()
+    return index, sp
+
+
+_EXPECT = {}
+
+
+def _expected(mesh, kind, tmp_path_factory):
+    """States of the UNINTERRUPTED stream: ``expect[j]`` = (epoch,
+    distances, ids) after step j (j=0 is the base index)."""
+    if kind in _EXPECT:
+        return _EXPECT[kind]
+    root = str(tmp_path_factory.mktemp(f"expected-{kind}"))
+    index, sp = _fresh_root(mesh, kind, root)
+    log = MutationLog(root, n_parts=N_PARTS, fsync=False)
+    s = Searcher("ivf_flat" if kind.startswith("flat") else "ivf_pq",
+                 mesh=mesh, index=index, search_params=sp, wal=log)
+    states = [(0,) + _search(mesh, kind, sp, s._index)]
+    for j, step in enumerate(_steps(kind), start=1):
+        step(s)
+        assert s.epoch == j
+        states.append((j,) + _search(mesh, kind, sp, s._index))
+    log.close()
+    _EXPECT[kind] = states
+    return states
+
+
+def _run_killed(mesh, kind, root, kill_step, phase, offset=45):
+    """Drive the stream with a scripted kill at ``kill_step`` (1-based)
+    and return the searcher (its in-memory state after the fault)."""
+    chaos = ChaosMonkey(seed=0)
+    file_io = FileIO()
+    post_append = None
+    at = (kill_step - 1,)                 # one WAL write per append
+    if phase == "pre":
+        file_io = FileIO(write_bytes=chaos.wrap_write(
+            "wal", faults=[FaultSpec(kind="raise", at=at)]))
+    elif phase == "torn":
+        file_io = FileIO(write_bytes=chaos.wrap_write(
+            "wal", faults=[FaultSpec(kind="torn_write", at=at,
+                                     offset=offset)]))
+    else:                                 # "post": durable, then killed
+        post_append = chaos.hook("commit")
+        chaos.script("commit", [FaultSpec(kind="raise", at=at)])
+    index, sp = _fresh_root(mesh, kind, root)
+    log = MutationLog(root, n_parts=N_PARTS, fsync=False,
+                      file_io=file_io, post_append=post_append)
+    s = Searcher("ivf_flat" if kind.startswith("flat") else "ivf_pq",
+                 mesh=mesh, index=index, search_params=sp, wal=log)
+    steps = _steps(kind)
+    for step in steps[:kill_step - 1]:
+        step(s)
+    with pytest.raises(InjectedFault):
+        steps[kill_step - 1](s)
+    log.close()
+    return s, sp
+
+
+def _check_recovery(mesh, kind, root, searcher, sp, expect, kill_step,
+                    phase):
+    # The faulted mutation never swapped in: the live endpoint still
+    # serves the last complete epoch.
+    assert searcher.epoch == kill_step - 1
+    # Pre-append / torn kills roll the mutation back; a post-append
+    # kill committed it (the record is durable) so recovery redoes it.
+    want = kill_step if phase == "post" else kill_step - 1
+    rec_index, log = recover(mesh, root, n_parts=N_PARTS, fsync=False)
+    try:
+        e, d, i = expect[want]
+        assert int(rec_index.epoch) == e
+        rd, ri = _search(mesh, kind, sp, rec_index)
+        np.testing.assert_array_equal(ri, i)
+        np.testing.assert_array_equal(rd, d)
+    finally:
+        log.close()
+
+
+class TestKillRecover:
+    """Kill the process at every point of every mutation; recovery must
+    reconstruct a complete epoch bit-identically."""
+
+    @pytest.mark.parametrize("phase", ["pre", "torn", "post"])
+    @pytest.mark.parametrize("kill_step",
+                             range(1, len(STREAM_STEPS) + 1),
+                             ids=STREAM_STEPS)
+    def test_flat_list(self, mesh4, tmp_path, tmp_path_factory,
+                       kill_step, phase):
+        kind = "flat_list"
+        expect = _expected(mesh4, kind, tmp_path_factory)
+        s, sp = _run_killed(mesh4, kind, str(tmp_path), kill_step, phase)
+        _check_recovery(mesh4, kind, str(tmp_path), s, sp, expect,
+                        kill_step, phase)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("phase", ["pre", "torn", "post"])
+    @pytest.mark.parametrize("kill_step",
+                             range(1, len(STREAM_STEPS) + 1),
+                             ids=STREAM_STEPS)
+    @pytest.mark.parametrize("kind", ["flat_row", "pq_list", "pq_row"])
+    def test_full_grid(self, mesh4, tmp_path, tmp_path_factory, kind,
+                       kill_step, phase):
+        expect = _expected(mesh4, kind, tmp_path_factory)
+        s, sp = _run_killed(mesh4, kind, str(tmp_path), kill_step, phase)
+        _check_recovery(mesh4, kind, str(tmp_path), s, sp, expect,
+                        kill_step, phase)
+
+    @pytest.mark.parametrize("offset", [0, 12, 39])
+    def test_torn_offsets_inside_the_frame(self, mesh4, tmp_path,
+                                           tmp_path_factory, offset):
+        """Tearing at the very first byte, mid-header, and mid-payload
+        all roll back identically."""
+        kind = "flat_list"
+        expect = _expected(mesh4, kind, tmp_path_factory)
+        s, sp = _run_killed(mesh4, kind, str(tmp_path), 2, "torn",
+                            offset=offset)
+        _check_recovery(mesh4, kind, str(tmp_path), s, sp, expect, 2,
+                        "torn")
+
+    def test_resume_stream_after_recovery(self, mesh4, tmp_path,
+                                          tmp_path_factory):
+        """Recovery hands back a live log: the remaining steps replayed
+        on the recovered index converge to the uninterrupted end
+        state."""
+        kind = "flat_list"
+        expect = _expected(mesh4, kind, tmp_path_factory)
+        kill_step = 3
+        s, sp = _run_killed(mesh4, kind, str(tmp_path), kill_step, "pre")
+        rec_index, log = recover(mesh4, str(tmp_path), n_parts=N_PARTS,
+                                 fsync=False)
+        s2 = Searcher("ivf_flat", mesh=mesh4, index=rec_index,
+                      search_params=sp, wal=log)
+        for step in _steps(kind)[kill_step - 1:]:
+            step(s2)
+        log.close()
+        e, d, i = expect[-1]
+        assert s2.epoch == e
+        rd, ri = _search(mesh4, kind, sp, s2._index)
+        np.testing.assert_array_equal(ri, i)
+        np.testing.assert_array_equal(rd, d)
+
+    def test_torn_snapshot_falls_back_to_older(self, mesh4, tmp_path,
+                                               tmp_path_factory):
+        """A kill mid-snapshot leaves the newest snapshot torn; recovery
+        quietly falls back to the previous one and replays further."""
+        kind = "flat_list"
+        expect = _expected(mesh4, kind, tmp_path_factory)
+        root = str(tmp_path)
+        index, sp = _fresh_root(mesh4, kind, root)
+        log = MutationLog(root, n_parts=N_PARTS, fsync=False)
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, wal=log)
+        for step in _steps(kind)[:3]:
+            step(s)
+        log.snapshot(s._index, mesh4)     # snap at epoch 3
+        for step in _steps(kind)[3:]:
+            step(s)
+        log.close()
+        # Tear the epoch-3 snapshot: grow one shard file (size/CRC
+        # mismatch vs its manifest entry).
+        shard = sorted(glob.glob(os.path.join(
+            root, "snapshots", "snap-000000000003.shard*.npz")))[0]
+        with open(shard, "ab") as f:
+            f.write(b"\x00")
+        rec_index, log2 = recover(mesh4, root, n_parts=N_PARTS,
+                                  fsync=False)
+        try:
+            assert log2.latest_snapshot()[0] == 0   # fell back
+            e, d, i = expect[-1]
+            assert int(rec_index.epoch) == e        # replayed 1..5
+            rd, ri = _search(mesh4, kind, sp, rec_index)
+            np.testing.assert_array_equal(ri, i)
+            np.testing.assert_array_equal(rd, d)
+        finally:
+            log2.close()
+
+    def test_replay_stops_at_epoch_gap(self, mesh4, tmp_path):
+        """A mid-stream record lost to corruption leaves an epoch gap;
+        replay stops at the last complete epoch instead of applying the
+        far side half-connected."""
+        kind = "flat_list"
+        root = str(tmp_path)
+        index, sp = _fresh_root(mesh4, kind, root, n_parts=1,
+                                segment_bytes=64)
+        log = MutationLog(root, n_parts=1, segment_bytes=64, fsync=False)
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, wal=log)
+        for step in _steps(kind)[:3]:
+            step(s)
+        # Drop the epoch-2 record's segment wholesale (n_parts=1 with
+        # per-record segments: seg 1 holds epoch 2).
+        os.remove(log._writers[0].segments()[1])
+        fresh, _ = _build(mesh4, kind)
+        replayed = replay(mesh4, fresh, log)
+        assert int(replayed.epoch) == 1
+        log.close()
+
+
+# ---------------------------------------------------------------------------
+# Followers + promotion
+
+
+class TestFollowerPromotion:
+    def _primary(self, mesh, root):
+        index, sp = _fresh_root(mesh, "flat_list", root)
+        log = MutationLog(root, n_parts=N_PARTS, fsync=False)
+        return Searcher("ivf_flat", mesh=mesh, index=index,
+                        search_params=sp, wal=log), sp, log
+
+    def _follower(self, mesh, root, sp):
+        idx, flog = recover(mesh, root, n_parts=N_PARTS, fsync=False)
+        # The recovered log stays attached as the searcher's WAL: after
+        # a promotion, the (now primary) endpoint keeps appending to it.
+        searcher = Searcher("ivf_flat", mesh=mesh, index=idx,
+                            search_params=sp, wal=flog)
+        return Follower(searcher, flog)
+
+    def test_follower_tails_and_rejects_writes(self, mesh4, tmp_path):
+        primary, sp, plog = self._primary(mesh4, str(tmp_path))
+        fol = self._follower(mesh4, str(tmp_path), sp)
+        assert fol.searcher.writable is False
+        with pytest.raises(LogicError, match="read-only"):
+            fol.searcher.delete(np.arange(4))
+        steps = _steps("flat_list")
+        steps[0](primary)
+        steps[1](primary)
+        assert fol.poll() == 2
+        assert fol.catch_up() == 2
+        assert fol.lag == 0 and fol.epoch == primary.epoch == 2
+        d, i = _search(mesh4, "flat_list", sp, primary._index)
+        fd, fi = _search(mesh4, "flat_list", sp, fol.searcher._index)
+        np.testing.assert_array_equal(fi, i)
+        np.testing.assert_array_equal(fd, d)
+        plog.close()
+        fol.log.close()
+
+    def test_promotion_on_primary_death(self, mesh4, tmp_path):
+        primary, sp, plog = self._primary(mesh4, str(tmp_path))
+        for step in _steps("flat_list"):
+            step(primary)
+        fol = self._follower(mesh4, str(tmp_path), sp)
+        health = ShardHealth(N_DEV)
+        mgr = PromotionManager(fol, health, primary_rank=0)
+        assert not mgr.promoted
+        health.mark_dead(0)               # the live->dead transition
+        assert mgr.promoted and mgr.promotions == 1
+        # Served within one epoch of the log head, zero lost mutations.
+        assert fol.epoch == fol.log.head_epoch() == primary.epoch
+        d, i = _search(mesh4, "flat_list", sp, primary._index)
+        fd, fi = _search(mesh4, "flat_list", sp, fol.searcher._index)
+        np.testing.assert_array_equal(fi, i)
+        np.testing.assert_array_equal(fd, d)
+        # Writable now: the promoted endpoint takes mutations and logs
+        # them under the next epoch.
+        fol.searcher.delete(np.arange(900, 908))
+        assert fol.epoch == primary.epoch + 1
+        assert fol.log.head_epoch() == fol.epoch
+        # Idempotent: re-entry is a no-op, dead ranks never re-fire.
+        assert mgr.promote() is False
+        assert mgr.promotions == 1
+        mgr.close()
+        plog.close()
+        fol.log.close()
+
+    def test_unwatched_rank_does_not_promote(self, mesh4, tmp_path):
+        primary, sp, plog = self._primary(mesh4, str(tmp_path))
+        _steps("flat_list")[0](primary)
+        fol = self._follower(mesh4, str(tmp_path), sp)
+        health = ShardHealth(N_DEV)
+        mgr = PromotionManager(fol, health, primary_rank=0)
+        health.mark_dead(2)               # some other shard
+        assert not mgr.promoted
+        assert fol.searcher.writable is False
+        mgr.close()
+        plog.close()
+        fol.log.close()
+
+
+# ---------------------------------------------------------------------------
+# Write-ahead ordering + stats plumbing
+
+
+class TestWriteAhead:
+    def test_mutations_rejected_when_not_writable(self, mesh4, tmp_path):
+        index, sp = _build(mesh4, "flat_list")
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, writable=False)
+        dim = 16
+        with pytest.raises(LogicError, match="read-only"):
+            s.extend(np.zeros((4, dim), np.float32))
+        with pytest.raises(LogicError, match="read-only"):
+            s.delete(np.arange(4))
+        with pytest.raises(LogicError, match="read-only"):
+            s.upsert(np.zeros((4, dim), np.float32), np.arange(4))
+        with pytest.raises(LogicError, match="read-only"):
+            s.compact()
+        # Reads still serve.
+        r = s.search(_db("flat_list")[:8], K)
+        assert r.indices.shape == (8, K)
+
+    def test_noop_delete_appends_no_record(self, mesh4, tmp_path):
+        index, sp = _fresh_root(mesh4, "flat_list", str(tmp_path))
+        log = MutationLog(str(tmp_path), n_parts=N_PARTS, fsync=False)
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, wal=log)
+        assert s.delete(np.arange(5000, 5004)) == 0   # ids don't exist
+        assert log.records() == [] and s.epoch == 0
+        log.close()
+
+    def test_stats_feed_and_fsync_drain(self, tmp_path):
+        clock = iter(np.arange(0.0, 10.0, 0.5))
+        stats = WalStats()
+        log = MutationLog(str(tmp_path), n_parts=1, fsync=True,
+                          stats=stats, monotonic=lambda: float(
+                              next(clock)))
+        log.append("extend", 1, _arrays(1, n=4))
+        log.append("delete", 2, _arrays(2, n=4))
+        assert stats.records == 2 and stats.head_epoch == 2
+        assert stats.bytes > 0 and stats.fsyncs == 2
+        lats = stats.drain_fsyncs()
+        assert lats == [0.5, 0.5]
+        assert stats.drain_fsyncs() == []     # observed exactly once
+        log.close()
+
+    def test_snapshot_cadence(self, mesh4, tmp_path):
+        index, sp = _build(mesh4, "flat_list")
+        log = MutationLog(str(tmp_path), n_parts=N_PARTS, fsync=False,
+                          snapshot_every=2)
+        log.snapshot(index, mesh4)
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, wal=log)
+        steps = _steps("flat_list")
+        steps[0](s)                        # epoch 1: no snapshot yet
+        assert log.stats.snapshots == 1
+        steps[1](s)                        # epoch 2: cadence fires
+        assert log.stats.snapshots == 2
+        assert log.latest_snapshot()[0] == 2
+        log.close()
+
+
+def test_durability_bench_smoke(capsys):
+    import json
+
+    from bench.durability import run
+
+    run(quick=True)
+    rows = [json.loads(l) for l in
+            capsys.readouterr().out.splitlines() if l.strip()]
+    metrics = {r["metric"] for r in rows}
+    assert "durability_wal_append_records_per_s" in metrics
+    assert "durability_snapshot_s" in metrics
+    assert "durability_restore_s" in metrics
+    assert "durability_replay_epochs_per_s" in metrics
+    assert {r["fsync"] for r in rows
+            if r["metric"] == "durability_wal_append_records_per_s"} \
+        == {True, False}
+    for r in rows:
+        assert r["value"] >= 0.0

@@ -1,0 +1,343 @@
+"""Elastic shard membership (raft_tpu/lifecycle/elastic.py) suite.
+
+The ISSUE-17 contracts: (a) ``leave_shard`` drains a shard — after the
+one published epoch bump no list (and no replica) lives on it, results
+stay bit-identical (whole-list migration moves rows, never drops them);
+(b) ``join_shard`` brings an idle shard into the serving set and load
+actually lands on it; (c) replicated lists stay replicated across a
+resize, re-placed off a leaver; (d) a resize under live scheduler
+traffic never surfaces a deleted id, a stale cached answer, partial
+coverage, or an exception (chaos lane); (e) with the routing ladder
+warmed in the background, post-cutover serving compiles NOTHING
+(sanitized lane); (f) a resize logs a ``migrate`` record — recovery
+replays it to the exact recorded placement.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from raft_tpu.core.error import LogicError
+from raft_tpu.lifecycle import (
+    MutationLog,
+    elastic_stats,
+    join_shard,
+    leave_shard,
+    recover,
+    serving_shards,
+)
+from raft_tpu.neighbors import ivf_flat
+from raft_tpu.parallel.ivf import (
+    sharded_ivf_flat_build,
+    sharded_ivf_flat_search,
+    sharded_replicate_lists,
+)
+from raft_tpu.parallel.routing import assign_lists
+from raft_tpu.serve import (
+    BatchPolicy,
+    BatchScheduler,
+    BucketGrid,
+    ResultCache,
+    Searcher,
+    warmup,
+)
+
+N_DEV = 4
+DIM = 16
+K = 5
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    devs = np.array(jax.devices())
+    assert devs.size >= N_DEV
+    return Mesh(devs[:N_DEV], ("data",))
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _release_compile_cache():
+    # Resize warmups compile a ladder per placement; free the
+    # executables when the module ends so the single-process tier-1
+    # run's peak RSS stays where it was before this file existed.
+    yield
+    jax.clear_caches()
+
+
+def _db(seed=3, n=1024):
+    return np.random.default_rng(seed).normal(size=(n, DIM)).astype(
+        np.float32)
+
+
+def _build(mesh, replicate=()):
+    db = _db()
+    params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+    model = ivf_flat.build(ivf_flat.IndexParams(
+        n_lists=8, kmeans_n_iters=4, add_data_on_build=False), db)
+    index = sharded_ivf_flat_build(mesh, params, db,
+                                   centers=model.centers,
+                                   placement="list")
+    if replicate:
+        index = sharded_replicate_lists(mesh, index, list(replicate))
+    sp = ivf_flat.SearchParams(n_probes=8)
+    return index, sp
+
+
+def _searcher(mesh, replicate=(), **kw):
+    index, sp = _build(mesh, replicate=replicate)
+    return Searcher("ivf_flat", mesh=mesh, index=index, search_params=sp,
+                    **kw), sp
+
+
+def _results(mesh, sp, index, q):
+    d, i = sharded_ivf_flat_search(mesh, sp, index, q, K)
+    return np.asarray(d), np.asarray(i)
+
+
+# ---------------------------------------------------------------------------
+# assign_lists over a restricted active set
+
+
+class TestAssignListsActive:
+    def test_owners_land_only_on_active(self):
+        rng = np.random.default_rng(5)
+        w = rng.uniform(1.0, 2.0, size=32)
+        centers = rng.normal(size=(32, DIM)).astype(np.float32)
+        owner = assign_lists(w, 4, centers=centers, active=[1, 3])
+        assert set(np.unique(owner)) <= {1, 3}
+        assert owner.shape == (32,)
+        # Both survivors actually carry load (size-balanced packing).
+        loads = [w[owner == r].sum() for r in (1, 3)]
+        assert min(loads) > 0.3 * max(loads)
+
+    def test_full_active_matches_unrestricted(self):
+        rng = np.random.default_rng(6)
+        w = rng.uniform(1.0, 2.0, size=16)
+        np.testing.assert_array_equal(
+            assign_lists(w, 4, active=[0, 1, 2, 3]),
+            assign_lists(w, 4))
+
+    def test_active_validation(self):
+        w = np.ones(8)
+        for bad in ([], [0, 0], [4], [-1]):
+            with pytest.raises(LogicError):
+                assign_lists(w, 4, active=bad)
+
+
+# ---------------------------------------------------------------------------
+# Join / leave correctness
+
+
+class TestJoinLeave:
+    def test_leave_drains_and_preserves_results(self, mesh4):
+        s, sp = _searcher(mesh4)
+        q = _db()[:16]
+        d0, i0 = _results(mesh4, sp, s._index, q)
+        e0 = s.epoch
+        assert serving_shards(s._index) == (0, 1, 2, 3)
+        rep = leave_shard(s, 3)
+        assert rep.action == "leave" and rep.rank == 3
+        assert rep.active_after == (0, 1, 2)
+        assert rep.epoch == s.epoch == e0 + 1    # ONE epoch bump
+        pm = s._index.placement_map
+        assert 3 not in set(np.unique(pm.owner))
+        assert 3 not in set(np.unique(pm.replica_owner[
+            pm.replica_owner >= 0])) if (pm.replica_owner >= 0).any() \
+            else True
+        assert serving_shards(s._index) == (0, 1, 2)
+        d1, i1 = _results(mesh4, sp, s._index, q)
+        np.testing.assert_array_equal(i1, i0)    # no row lost or moved
+        np.testing.assert_array_equal(d1, d0)    # out of the result set
+
+    def test_join_restores_the_shard(self, mesh4):
+        s, sp = _searcher(mesh4)
+        q = _db()[:16]
+        d0, i0 = _results(mesh4, sp, s._index, q)
+        leave_shard(s, 0)
+        rep = join_shard(s, 0)
+        assert rep.action == "join" and rep.active_after == (0, 1, 2, 3)
+        assert rep.lists_moved > 0               # load landed on it
+        assert 0 in serving_shards(s._index)
+        assert s.epoch == 2
+        d1, i1 = _results(mesh4, sp, s._index, q)
+        np.testing.assert_array_equal(i1, i0)
+        np.testing.assert_array_equal(d1, d0)
+
+    def test_replicas_survive_and_avoid_the_leaver(self, mesh4):
+        s, sp = _searcher(mesh4, replicate=(0, 1))
+        pm = s._index.placement_map
+        assert (pm.replica_owner[[0, 1]] >= 0).all()
+        # Drain whichever shard holds list 0's replica: the
+        # fault-tolerance copy must move, not vanish.
+        leaver = int(pm.replica_owner[0])
+        leave_shard(s, leaver)
+        pm = s._index.placement_map
+        assert (pm.replica_owner[[0, 1]] >= 0).all()   # still replicated
+        for lst in (0, 1):
+            assert pm.replica_owner[lst] != leaver
+            assert pm.owner[lst] != leaver
+            assert pm.replica_owner[lst] != pm.owner[lst]
+
+    def test_validation(self, mesh4):
+        s, sp = _searcher(mesh4)
+        with pytest.raises(LogicError, match="already serves"):
+            join_shard(s, 2)
+        with pytest.raises(LogicError, match="outside the mesh"):
+            leave_shard(s, 7)
+        leave_shard(s, 1)
+        with pytest.raises(LogicError, match="no lists"):
+            leave_shard(s, 1)
+        # Drain to one shard; the last one must not leave.
+        leave_shard(s, 2)
+        leave_shard(s, 3)
+        assert serving_shards(s._index) == (0,)
+        with pytest.raises(LogicError, match="last serving shard"):
+            leave_shard(s, 0)
+        # All rows still served from the one survivor.
+        q = _db()[:8]
+        d, i = _results(mesh4, sp, s._index, q)
+        assert i.shape == (8, K)
+
+    def test_row_placement_rejected(self, mesh4):
+        db = _db()
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        index = sharded_ivf_flat_build(mesh4, params, db)   # placement=row
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=ivf_flat.SearchParams(n_probes=8))
+        with pytest.raises(LogicError, match="placement='list'"):
+            leave_shard(s, 0)
+
+    def test_readonly_follower_cannot_resize(self, mesh4):
+        s, sp = _searcher(mesh4, writable=False)
+        with pytest.raises(LogicError, match="read-only"):
+            leave_shard(s, 0)
+
+    def test_stats_feed(self, mesh4):
+        elastic_stats.reset()
+        s, sp = _searcher(mesh4)
+        leave_shard(s, 3)
+        join_shard(s, 3)
+        snap = elastic_stats.snapshot()
+        assert snap["joins"] == 1 and snap["leaves"] == 1
+        assert snap["lists_moved"] >= 1
+        assert snap["last_epoch"] == s.epoch == 2
+
+    def test_resize_replays_from_the_log(self, mesh4, tmp_path):
+        """A join/leave is a logged mutation: recovery reproduces the
+        exact post-resize placement and results."""
+        index, sp = _build(mesh4, replicate=(0,))
+        e0 = int(index.epoch)              # replication published once
+        log = MutationLog(str(tmp_path), n_parts=2, fsync=False)
+        log.snapshot(index, mesh4)
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=sp, wal=log)
+        leave_shard(s, 2)
+        join_shard(s, 2)
+        log.close()
+        rec, log2 = recover(mesh4, str(tmp_path), n_parts=2, fsync=False)
+        try:
+            assert int(rec.epoch) == s.epoch == e0 + 2
+            np.testing.assert_array_equal(rec.placement_map.owner,
+                                          s._index.placement_map.owner)
+            np.testing.assert_array_equal(
+                rec.placement_map.replica_owner,
+                s._index.placement_map.replica_owner)
+            q = _db()[:16]
+            d0, i0 = _results(mesh4, sp, s._index, q)
+            d1, i1 = _results(mesh4, sp, rec, q)
+            np.testing.assert_array_equal(i1, i0)
+            np.testing.assert_array_equal(d1, d0)
+        finally:
+            log2.close()
+
+
+# ---------------------------------------------------------------------------
+# Resize under live traffic
+
+
+@pytest.mark.chaos
+def test_resize_under_traffic(mesh4):
+    """Join-then-leave while the scheduler pumps: no request ever sees
+    a deleted id, partial coverage, a stale cached answer, or an
+    exception; the serving set ends where it started and results match
+    an undisturbed reference."""
+    index, sp = _build(mesh4)
+    dels = np.arange(0, 256, 4)
+    grid = BucketGrid.pow2(8, k_grid=(K,))
+    searcher = Searcher("ivf_flat", mesh=mesh4, index=index,
+                        search_params=sp)
+    searcher.delete(dels)
+    sched = BatchScheduler(searcher, grid,
+                           BatchPolicy(max_batch=8, max_wait=0.0),
+                           cache=ResultCache(64))
+    warmup(searcher, grid)
+    errors, done = [], threading.Event()
+
+    def serve_loop():
+        try:
+            r = np.random.default_rng(85)
+            while not done.is_set():
+                q = r.normal(size=(4, DIM)).astype(np.float32)
+                t = sched.submit(q, K)
+                sched.run_until_idle()
+                res = t.result()
+                assert not np.intersect1d(res.indices.ravel(),
+                                          dels).size, "deleted id served"
+                assert (res.coverage == 1.0).all(), "partial coverage"
+        except Exception as e:                 # pragma: no cover
+            errors.append(e)
+
+    th = threading.Thread(target=serve_loop, daemon=True)
+    th.start()
+    try:
+        for rank in (3, 2):
+            leave_shard(searcher, rank, grid=grid)
+        for rank in (2, 3):
+            join_shard(searcher, rank, grid=grid)
+    finally:
+        done.set()
+        th.join(timeout=30.0)
+    sched.close()
+    assert not errors, errors
+    assert serving_shards(searcher._index) == (0, 1, 2, 3)
+    assert searcher.epoch == 5                 # 1 delete + 4 resizes
+    # Undisturbed reference: same build, same delete, no resizes.
+    ref, _ = _build(mesh4)
+    from raft_tpu.lifecycle import delete
+    delete(ref, dels, mesh=mesh4)
+    q = _db(9, n=16)
+    d0, i0 = _results(mesh4, sp, ref, q)
+    d1, i1 = _results(mesh4, sp, searcher._index, q)
+    np.testing.assert_array_equal(i1, i0)
+    np.testing.assert_array_equal(d1, d0)
+
+
+# ---------------------------------------------------------------------------
+# Sanitized lane: warmed cutover compiles nothing
+
+
+@pytest.mark.sanitized
+def test_resize_cutover_steady_state(mesh4, sanitizer_lane):
+    """Acceptance: with the successor's routed ladder warmed in the
+    background (``grid=``), post-cutover serving reuses the warmed
+    traces — zero implicit transfers, zero steady-state recompiles.
+    The resize pass itself is control-plane (explicit host syncs)."""
+    rng = np.random.default_rng(44)
+    with sanitizer_lane.allow_transfers():     # builds are not a hot path
+        s, sp = _searcher(mesh4)
+    grid = BucketGrid(q_buckets=(8,), k_grid=(K,))
+    warmup(s, grid)
+    s.search(rng.normal(size=(8, DIM)).astype(np.float32), K)
+    with sanitizer_lane.allow_transfers():     # control plane
+        rep = leave_shard(s, 3, grid=grid)
+        assert rep.warmed_shapes > 0
+    sanitizer_lane.mark_steady()
+
+    for _ in range(3):
+        q = rng.normal(size=(8, DIM)).astype(np.float32)
+        res = s.search(q, K)
+        assert res.indices.shape == (8, K)
+    assert sanitizer_lane.steady_compiles == 0

@@ -1040,3 +1040,122 @@ def test_bench_obs_family_smoke(capsys):
             "obs_probe_overhead_pct"} <= set(recs)
     assert recs["obs_tracer_off_qps"]["value"] > 0
     assert recs["obs_scrape_ms"]["value"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Durability + elastic telemetry on the scrape (ISSUE 17 satellite)
+
+
+class TestDurabilityCollectors:
+    def test_wal_collector_scrape_surface(self, mesh4, tmp_path):
+        """Log bytes/records, the fsync latency histogram, snapshot
+        markers and per-follower replay lag all land on one scrape —
+        fed from host counters only (no file or device touch at scrape
+        time)."""
+        from raft_tpu.lifecycle import Follower, MutationLog, recover
+        from raft_tpu.obs import WalCollector
+        from raft_tpu.parallel import sharded_ivf_flat_build
+
+        rng = np.random.default_rng(57)
+        db = rng.normal(size=(256, DIM)).astype(np.float32)
+        index = sharded_ivf_flat_build(
+            mesh4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2),
+            db, placement="list")
+        sp = ivf_flat.SearchParams(n_probes=8)
+        clock = iter(np.arange(0.0, 100.0, 0.25))
+        log = MutationLog(str(tmp_path), n_parts=2, fsync=True,
+                          monotonic=lambda: float(next(clock)))
+        log.snapshot(index, mesh4)
+        primary = Searcher("ivf_flat", mesh=mesh4, index=index,
+                           search_params=sp, wal=log)
+        primary.delete(np.arange(16))
+        primary.extend(rng.normal(size=(32, DIM)).astype(np.float32))
+
+        fidx, flog = recover(mesh4, str(tmp_path), n_parts=2,
+                             fsync=False)
+        follower = Follower(
+            Searcher("ivf_flat", mesh=mesh4, index=fidx,
+                     search_params=sp, wal=flog), flog)
+        primary.delete(np.arange(16, 24))      # follower now lags by 1
+        follower.poll()
+
+        reg = MetricsRegistry()
+        col = WalCollector(reg, log.stats, followers=[follower])
+        text = reg.prometheus_text()
+        assert "raft_wal_records_total 3" in text
+        assert "raft_wal_bytes_total" in text
+        assert "raft_wal_snapshots_total 1" in text
+        assert "raft_wal_head_epoch 3" in text
+        assert "raft_wal_snapshot_epoch 0" in text
+        assert 'raft_wal_replay_lag_epochs{follower="0"} 1' in text
+        assert 'raft_wal_fsync_seconds_count 3' in text
+        # Each fsync latency observed exactly once across scrapes.
+        assert 'raft_wal_fsync_seconds_count 3' in reg.prometheus_text()
+        follower.catch_up()
+        assert ('raft_wal_replay_lag_epochs{follower="0"} 0'
+                in reg.prometheus_text())
+        col.close()
+        log.close()
+        flog.close()
+
+    def test_promotion_counter_on_scrape(self, mesh4, tmp_path):
+        from raft_tpu.lifecycle import (Follower, MutationLog,
+                                        PromotionManager, recover)
+        from raft_tpu.obs import WalCollector
+        from raft_tpu.parallel import sharded_ivf_flat_build
+
+        rng = np.random.default_rng(58)
+        db = rng.normal(size=(256, DIM)).astype(np.float32)
+        index = sharded_ivf_flat_build(
+            mesh4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2),
+            db, placement="list")
+        sp = ivf_flat.SearchParams(n_probes=8)
+        log = MutationLog(str(tmp_path), n_parts=1, fsync=False)
+        log.snapshot(index, mesh4)
+        log.close()
+        fidx, flog = recover(mesh4, str(tmp_path), n_parts=1,
+                             fsync=False)
+        follower = Follower(
+            Searcher("ivf_flat", mesh=mesh4, index=fidx,
+                     search_params=sp, wal=flog), flog)
+        health = ShardHealth(N_DEV)
+        mgr = PromotionManager(follower, health, primary_rank=0)
+        reg = MetricsRegistry()
+        WalCollector(reg, flog.stats, followers=[follower],
+                     promotion=mgr)
+        assert "raft_wal_promotions_total 0" in reg.prometheus_text()
+        health.mark_dead(0)
+        assert "raft_wal_promotions_total 1" in reg.prometheus_text()
+        mgr.close()
+        flog.close()
+
+    def test_elastic_collector_scrape_surface(self, mesh4):
+        from raft_tpu.lifecycle import join_shard, leave_shard
+        from raft_tpu.lifecycle.elastic import ElasticStats, elastic_stats
+        from raft_tpu.obs import ElasticCollector
+        from raft_tpu.parallel import sharded_ivf_flat_build
+
+        rng = np.random.default_rng(59)
+        db = rng.normal(size=(256, DIM)).astype(np.float32)
+        index = sharded_ivf_flat_build(
+            mesh4, ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=2),
+            db, placement="list")
+        s = Searcher("ivf_flat", mesh=mesh4, index=index,
+                     search_params=ivf_flat.SearchParams(n_probes=8))
+        elastic_stats.reset()
+        reg = MetricsRegistry()
+        col = ElasticCollector(reg)            # defaults to the singleton
+        assert col.stats is elastic_stats
+        leave_shard(s, 3)
+        join_shard(s, 3)
+        text = reg.prometheus_text()
+        assert "raft_elastic_joins_total 1" in text
+        assert "raft_elastic_leaves_total 1" in text
+        assert "raft_elastic_last_epoch 2" in text
+        moved = [l for l in text.splitlines()
+                 if l.startswith("raft_elastic_lists_moved_total")]
+        assert moved and int(float(moved[0].split()[-1])) >= 1
+        # An isolated stats object scrapes independently.
+        reg2 = MetricsRegistry()
+        ElasticCollector(reg2, stats=ElasticStats())
+        assert "raft_elastic_joins_total 0" in reg2.prometheus_text()

@@ -285,3 +285,146 @@ class TestRoundtripFidelity:
         d0, i0 = ivf_pq.search(sp, index, q, 10)
         d1, i1 = ivf_pq.search(sp, loaded, q, 10)
         np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+
+
+class TestCrashSafeShardedSave:
+    """ISSUE-17 satellite: a kill at ANY byte of ``sharded_ivf_save``
+    leaves either a complete verifiable snapshot or one that fails
+    LOUDLY at load — never a half-loaded index (chaos-driven via the
+    atomic_io ``FileIO`` seam)."""
+
+    pytestmark = pytest.mark.chaos
+
+    def _sharded(self, rng, mesh):
+        from raft_tpu.parallel import sharded_ivf_flat_build
+
+        from raft_tpu.neighbors import ivf_flat as fl
+
+        db = rng.normal(size=(512, 16)).astype(np.float32)
+        return sharded_ivf_flat_build(
+            mesh, fl.IndexParams(n_lists=8, kmeans_n_iters=3), db), db
+
+    @pytest.fixture()
+    def mesh4(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices())
+        if devs.size < 4:
+            pytest.skip("needs >= 4 virtual devices")
+        return Mesh(devs[:4], ("data",))
+
+    def test_torn_shard_write_never_half_loads(self, rng_mod, mesh4,
+                                               tmp_path):
+        """Power loss mid-``write(2)`` of a shard file: the torn bytes
+        live in ``.tmp``, the final name was never renamed, and the
+        manifest was never written — load fails up front."""
+        from raft_tpu.parallel import sharded_ivf_load, sharded_ivf_save
+        from raft_tpu.testing.chaos import (ChaosMonkey, FaultSpec,
+                                            InjectedFault)
+        from raft_tpu.util.atomic_io import FileIO
+
+        index, _ = self._sharded(rng_mod, mesh4)
+        base = str(tmp_path / "snap")
+        chaos = ChaosMonkey(seed=0)
+        # Write order: model, shard0..3, manifest -> tear shard1.
+        io = FileIO(write_bytes=chaos.wrap_write("save", faults=[
+            FaultSpec(kind="torn_write", at=(2,), offset=64)]))
+        with pytest.raises(InjectedFault):
+            sharded_ivf_save(base, index, file_io=io)
+        import os
+        assert os.path.exists(f"{base}.shard1.npz.tmp")   # the torn tmp
+        assert not os.path.exists(f"{base}.manifest.npz")  # no commit
+        with pytest.raises(Exception, match="missing shard|torn"):
+            sharded_ivf_load(mesh4, base)
+
+    def test_dropped_rename_never_half_loads(self, rng_mod, mesh4,
+                                             tmp_path):
+        """A kill between the per-file renames: some files published,
+        some orphaned as ``.tmp`` — the manifest is absent and the
+        existence pre-check refuses the torn set."""
+        from raft_tpu.parallel import sharded_ivf_load, sharded_ivf_save
+        from raft_tpu.testing.chaos import (ChaosMonkey, FaultSpec,
+                                            InjectedFault)
+        from raft_tpu.util.atomic_io import FileIO
+
+        index, _ = self._sharded(rng_mod, mesh4)
+        base = str(tmp_path / "snap")
+        chaos = ChaosMonkey(seed=0)
+        io = FileIO(replace=chaos.wrap_rename("pub", faults=[
+            FaultSpec(kind="partial_rename", at=(3,))]))
+        with pytest.raises(InjectedFault):
+            sharded_ivf_save(base, index, file_io=io)
+        import os
+        assert os.path.exists(f"{base}.shard0.npz")       # published
+        assert not os.path.exists(f"{base}.shard2.npz")   # dropped
+        with pytest.raises(Exception, match="missing shard|torn"):
+            sharded_ivf_load(mesh4, base)
+
+    def test_manifest_catches_post_save_corruption(self, rng_mod, mesh4,
+                                                   tmp_path):
+        """Size drift and CRC drift against the manifest both fail the
+        verify before a single tensor is placed."""
+        from raft_tpu.parallel import (sharded_ivf_load, sharded_ivf_save,
+                                       verify_sharded_manifest)
+
+        index, _ = self._sharded(rng_mod, mesh4)
+        base = str(tmp_path / "snap")
+        sharded_ivf_save(base, index)
+        assert verify_sharded_manifest(base) == 0          # clean
+        shard = f"{base}.shard2.npz"
+        raw = open(shard, "rb").read()
+        open(shard, "ab").write(b"\x00")                   # size drift
+        with pytest.raises(Exception, match="bytes, manifest says"):
+            sharded_ivf_load(mesh4, base)
+        flipped = bytearray(raw)
+        flipped[len(raw) // 2] ^= 0xFF                     # CRC drift
+        open(shard, "wb").write(bytes(flipped))
+        with pytest.raises(Exception, match="CRC"):
+            sharded_ivf_load(mesh4, base)
+
+    def test_legacy_manifestless_save_still_loads(self, rng_mod, mesh4,
+                                                  tmp_path):
+        """Pre-manifest file sets (or a kill exactly between the last
+        shard rename and the manifest rename) stay loadable: every data
+        file is complete, only torn-set detection degrades to the
+        existence check."""
+        import os
+
+        from raft_tpu.neighbors import ivf_flat as fl
+        from raft_tpu.parallel import (sharded_ivf_flat_search,
+                                       sharded_ivf_load,
+                                       sharded_ivf_save)
+
+        index, db = self._sharded(rng_mod, mesh4)
+        base = str(tmp_path / "legacy")
+        sharded_ivf_save(base, index)
+        os.remove(f"{base}.manifest.npz")
+        loaded = sharded_ivf_load(mesh4, base)
+        sp = fl.SearchParams(n_probes=8)
+        d0, i0 = sharded_ivf_flat_search(mesh4, sp, index, db[:8], 5)
+        d1, i1 = sharded_ivf_flat_search(mesh4, sp, loaded, db[:8], 5)
+        np.testing.assert_array_equal(np.asarray(i0), np.asarray(i1))
+        np.testing.assert_array_equal(np.asarray(d0), np.asarray(d1))
+
+    def test_transient_write_error_retried(self, rng_mod, mesh4,
+                                           tmp_path):
+        """``retry=`` rides out a transient OSError on a file write —
+        the save completes and verifies on the later attempt."""
+        from raft_tpu.core.retry import RetryPolicy
+        from raft_tpu.parallel import (sharded_ivf_load, sharded_ivf_save,
+                                       verify_sharded_manifest)
+        from raft_tpu.testing.chaos import ChaosMonkey, FaultSpec
+        from raft_tpu.util.atomic_io import FileIO
+
+        index, _ = self._sharded(rng_mod, mesh4)
+        base = str(tmp_path / "snap")
+        chaos = ChaosMonkey(seed=0)
+        io = FileIO(write_bytes=chaos.wrap_write("save", faults=[
+            FaultSpec(kind="raise", at=(0, 2))]))
+        sharded_ivf_save(base, index, file_io=io,
+                         retry=RetryPolicy(max_attempts=3,
+                                           base_delay=0.0))
+        assert verify_sharded_manifest(base) == 0
+        loaded = sharded_ivf_load(mesh4, base)
+        assert int(loaded.indices.shape[0]) == 4
