@@ -643,6 +643,17 @@ def _family_routing():
     run_routing(quick=False)
 
 
+def _family_degrade():
+    """Tail-robustness metrics (ISSUE 19): p99 + coverage with a 10x
+    straggler under hedged vs unhedged dispatch, recall-vs-latency down
+    the brownout ladder's n_probes rungs, and circuit-breaker
+    re-admission cost. Body lives in bench/degrade.py (shared with the
+    tier-1 smoke test)."""
+    from bench.degrade import run
+
+    run(quick=False)
+
+
 def _sift_like(n_db=10_000, n_q=1_000, dim=128, seed=0):
     rng = np.random.default_rng(seed)
     db = rng.integers(0, 256, size=(n_db, dim)).astype(np.float32)
@@ -745,6 +756,7 @@ def main():
         _run_family(_family_serve, "bench_serve_error")
         _run_family(_family_obs, "bench_obs_error")
         _run_family(_family_lifecycle, "bench_lifecycle_error")
+        _run_family(_family_degrade, "bench_degrade_error")
         _run_family(_family_1m, "bench_1m_error")
         _run_family(_family_sift1m_u8, "bench_sift1m_error")
         _run_family(_family_4m, "bench_4m_error")

@@ -1,0 +1,238 @@
+"""Tail-robustness bench family (ISSUE 19).
+
+Measures the straggler/overload defenses (raft_tpu/serve hedge +
+degradation ladder + recovery breaker), bench.py-style
+one-JSON-row-per-metric:
+
+* ``degrade_straggler_p99_ms`` — per-request p99 latency on the
+  INJECTED clock with one shard scripted 10x slow (``ChaosMonkey``
+  ``delay`` fault), one row per mode: ``healthy`` (no fault),
+  ``unhedged`` (fault, no defense — p99 tracks the straggler) and
+  ``hedged`` (fault + latency-aware SUSPECT + hedged replica dispatch
+  — after the straggler is convicted, its traffic serves through the
+  replicas and p99 returns to the healthy baseline).  ``coverage_min``
+  rides each row: the hedge never trades coverage for latency.
+* ``degrade_rung_recall`` / ``degrade_rung_latency_ms`` — recall@k vs
+  exact ground truth and mean wall latency at every brownout-ladder
+  rung (full / reduced / brownout n_probes): the quality/latency curve
+  the deadline ladder walks down.
+* ``degrade_breaker_readmit_probes`` / ``degrade_breaker_readmit_s`` —
+  shadow probes and injected-clock seconds from a shard's death to its
+  circuit-breaker re-admission (``RecoveryProber``, N consecutive
+  clean probes).
+
+The straggler stream targets one rank per request (queries at the
+centers of that rank's owned lists, ``n_probes=1``) so per-shard
+latency attribution is exact — a dispatch's elapsed time lands only on
+its participants, and the victim's EWMA diverges from the fleet median
+instead of dragging it along.  All timing decisions ride the injected
+sim clock (wall time appears only in the rung-latency row, which times
+real device work); the chaos schedule is seeded, so every row replays
+bit-identically.  ``quick=True`` is the CI smoke shape (tier-1 runs it
+via tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def _emit(metric, value, unit, **extra):
+    rec = {"metric": metric, "value": round(float(value), 4), "unit": unit,
+           "vs_baseline": 1.0}
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+class _SimClock:
+    """Injected monotonic clock: dispatch hooks and chaos delays advance
+    it; nothing reads wall time."""
+
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.now += seconds
+
+
+#: Simulated per-dispatch service time (seconds on the sim clock).
+SERVICE = 0.001
+
+
+def _make_hook(clock, on_ranks=None):
+    """Dispatch hook: every routed dispatch costs SERVICE on the sim
+    clock; a chaos ``rank_hook`` stacks the scripted straggler delay on
+    top when the victim participates."""
+
+    def hook(ranks):
+        clock.sleep(SERVICE)
+        if on_ranks is not None:
+            on_ranks(ranks)
+
+    return hook
+
+
+def _p99(lats) -> float:
+    import numpy as np
+
+    s = np.sort(np.asarray(lats, np.float64))
+    return float(s[min(len(s) - 1, int(np.ceil(0.99 * len(s))) - 1)])
+
+
+def run(quick: bool = False) -> None:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from raft_tpu.comms.health import LatencyPolicy, ShardHealth
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (
+        sharded_ivf_flat_build,
+        sharded_replicate_lists,
+    )
+    from raft_tpu.serve import HedgePolicy, RecoveryProber, Searcher
+    from raft_tpu.testing.chaos import ChaosMonkey, FaultSpec
+
+    rng = np.random.default_rng(19)
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs[:4], ("data",))
+    n_dev = 4
+    if quick:
+        n, d, n_lists, n_probes = 2048, 16, 16, 8
+        n_warm_cycles, n_requests, q_rows = 4, 48, 8
+    else:
+        n, d, n_lists, n_probes = 32_768, 32, 64, 32
+        n_warm_cycles, n_requests, q_rows = 4, 400, 8
+    k = 10
+
+    # Clustered database so routing is non-trivial (queries near one
+    # cluster probe few shards).
+    cluster_centers = rng.normal(size=(n_lists, d)).astype(np.float32) * 4
+    assign = rng.integers(0, n_lists, size=n)
+    db = (cluster_centers[assign]
+          + rng.normal(size=(n, d)).astype(np.float32))
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4)
+    sp = ivf_flat.SearchParams(n_probes=n_probes)
+    # The straggler lane routes at n_probes=1: each query probes exactly
+    # its nearest list, so a dispatch's participant set (and therefore
+    # its latency attribution) is exactly the targeted rank.
+    sp_route = ivf_flat.SearchParams(n_probes=1)
+    base = sharded_ivf_flat_build(mesh, params, db, placement="list")
+    victim = 1
+    pm = base.placement_map
+    index = sharded_replicate_lists(
+        mesh, base, np.flatnonzero(pm.owner == victim))
+    centers = np.asarray(jax.device_get(index.centers))
+    rank_lists = [np.flatnonzero(pm.owner == r) for r in range(n_dev)]
+
+    def _rank_queries(rank, j=0, m=None):
+        """m queries at (near) the center of ONE list ``rank`` owns
+        (cycled by ``j``).  n_probes=1 plus a single probed list keeps
+        the dispatch's participant set to exactly one shard — replica
+        read balancing is whole-list, so a one-list batch cannot split
+        across copies — which makes per-shard latency attribution
+        exact: the straggler's slow samples land only on the
+        straggler."""
+        m = q_rows if m is None else m
+        lists = rank_lists[rank]
+        pick = np.full(m, lists[j % len(lists)])
+        return (centers[pick]
+                + 0.01 * rng.normal(size=(m, d)).astype(np.float32))
+
+    def _queries(m):
+        cid = rng.integers(0, n_lists, size=m)
+        return (cluster_centers[cid]
+                + rng.normal(size=(m, d)).astype(np.float32))
+
+    # -- straggler p99: healthy / unhedged / hedged ------------------------
+    def _stream(searcher, clock, fault_after_warm, monkey):
+        lats, cov_min = [], 1.0
+        for i in range(n_warm_cycles * n_dev):
+            searcher.search(_rank_queries(i % n_dev, i // n_dev), k)
+        if fault_after_warm:
+            monkey.script("serve.dispatch", [FaultSpec(
+                kind="delay", at=None, rank=victim,
+                seconds=10 * SERVICE)])
+        for i in range(n_requests):
+            t0 = clock()
+            out = searcher.search(_rank_queries(i % n_dev, i // n_dev), k)
+            lats.append(clock() - t0)
+            cov_min = min(cov_min, float(out.coverage.min()))
+        return lats, cov_min
+
+    def _mode(mode):
+        clock = _SimClock()
+        monkey = ChaosMonkey(seed=19, sleep=clock.sleep)
+        hook = _make_hook(clock, monkey.rank_hook("serve.dispatch"))
+        kw = dict(mesh=mesh, dispatch_hook=hook, monotonic=clock)
+        if mode == "hedged":
+            kw["health"] = ShardHealth(n_dev, latency=LatencyPolicy(
+                alpha=0.25, window=8, quantile=0.9, multiplier=3.0,
+                min_samples=4))
+            kw["hedge"] = HedgePolicy(quantile=0.9, multiplier=2.0,
+                                      min_samples=4)
+        s = Searcher.ivf_flat(index, sp_route, **kw)
+        lats, cov_min = _stream(s, clock, mode != "healthy", monkey)
+        extra = dict(mode=mode, coverage_min=cov_min,
+                     n_requests=n_requests)
+        if mode == "hedged":
+            extra.update(s.hedge_stats.snapshot())
+            extra["n_suspect"] = int(kw["health"].n_suspect())
+        _emit("degrade_straggler_p99_ms", _p99(lats) * 1e3, "ms", **extra)
+        return s, kw.get("health"), clock, monkey
+
+    _mode("healthy")
+    _mode("unhedged")
+    hedged_s, health, clock, monkey = _mode("hedged")
+
+    # -- ladder rungs: recall vs latency -----------------------------------
+    n_eval = 64 if quick else 128
+    qeval = _queries(n_eval)
+    truth = np.empty((n_eval, k), np.int64)
+    for i in range(n_eval):     # chunked exact scan (host ground truth)
+        dd = ((qeval[i] - db) ** 2).sum(-1)
+        truth[i] = np.argsort(dd)[:k]
+    plain = Searcher.ivf_flat(index, sp, mesh=mesh)
+    for frac in (1.0, 0.5, 0.25):
+        npr = max(1, int(n_probes * frac))
+        out = plain.search(qeval, k, n_probes=npr)   # warm the rung
+        reps = 1 if quick else 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = plain.search(qeval, k, n_probes=npr)
+        lat_ms = (time.perf_counter() - t0) / reps * 1e3
+        hit = np.mean([len(set(map(int, out.indices[i]))
+                           & set(map(int, truth[i]))) / k
+                       for i in range(n_eval)])
+        _emit("degrade_rung_recall", hit, "recall@%d" % k,
+              rung_frac=frac, n_probes=npr)
+        _emit("degrade_rung_latency_ms", lat_ms, "ms",
+              rung_frac=frac, n_probes=npr)
+
+    # -- breaker re-admission ----------------------------------------------
+    monkey.clear("serve.dispatch")   # the straggler recovered
+    health.mark_dead(victim)
+    prober = RecoveryProber(hedged_s, health, _rank_queries(victim), k,
+                            clean_threshold=3, budget=5 * SERVICE)
+    t_dead = clock()
+    probes0 = prober.probes_sent
+    steps = 0
+    while health.state(victim) != "live" and steps < 32:
+        prober.step()
+        steps += 1
+    _emit("degrade_breaker_readmit_probes",
+          prober.probes_sent - probes0, "probes",
+          clean_threshold=3, readmitted=health.is_live(victim))
+    _emit("degrade_breaker_readmit_s", clock() - t_dead, "s",
+          clean_threshold=3)
+    prober.close()
+
+
+if __name__ == "__main__":
+    import sys
+
+    run(quick="--quick" in sys.argv)

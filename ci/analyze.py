@@ -47,6 +47,15 @@ lock-discipline classes owning a threading.Lock may touch their
 sentinel        merge/padding sentinels (±inf distances, -1 ids) in the
                 merge-path modules must come from
                 raft_tpu/core/sentinels.py, never re-typed literals.
+wall-clock      serve/ and lifecycle/ logic must read the INJECTED
+                clock, never call time.time()/time.monotonic()/
+                time.perf_counter()/time.sleep() directly — wall time
+                in a scheduling or health decision makes replay
+                nondeterministic and unfakeable in tests (the
+                injectable-clock discipline every serving subsystem
+                documents).  Referencing ``time.monotonic`` as a
+                DEFAULT (no call) stays legal — that is the injection
+                point itself.
 recompile-risk  outside traced code, an array extent must not derive
                 from a device value materialized to a host int
                 (``cap = int(jnp.max(counts))`` feeding
@@ -62,7 +71,7 @@ Incremental cache
 
 Results are memoized under ``<root>/.analyze_cache`` in two tiers:
 ``mod-<hash>.json`` holds one module's local-check results
-(style/cite/epoch-bump/lock-discipline/sentinel) keyed by the module's
+(style/cite/epoch-bump/lock-discipline/sentinel/wall-clock) keyed by the module's
 content, and ``graph-<hash>.json`` holds the whole-program checks
 (host-sync/axis-name/recompile-risk) keyed by every module's content —
 an interprocedural finding may move when ANY module changes, so the
@@ -112,19 +121,27 @@ ROOT = Path(__file__).resolve().parent.parent
 SCAN = ["raft_tpu", "pylibraft", "raft_dask", "tests", "bench", "ci"]
 
 CHECKS = ("style", "cite", "host-sync", "axis-name", "epoch-bump",
-          "lock-discipline", "sentinel", "recompile-risk")
+          "lock-discipline", "sentinel", "recompile-risk", "wall-clock")
 
 # Cache tiers: a LOCAL check reads one module in isolation, so its
 # results key on that module's content alone; a GRAPH check walks the
 # interprocedural call graph, so its results key on every module.
 LOCAL_CHECKS = ("style", "cite", "epoch-bump", "lock-discipline",
-                "sentinel")
+                "sentinel", "wall-clock")
 GRAPH_CHECKS = ("host-sync", "axis-name", "recompile-risk")
 
 # Semantic findings are emitted for the library tree only (the whole
 # tree still feeds the call graph, so tests/bench wrappers count for
 # reachability).
 SEMANTIC_SCOPE = "raft_tpu/"
+
+# Injected-clock discipline scope: serving/lifecycle decision logic
+# must read the clock it was constructed with, never wall time — a
+# wall-clock read makes shed/hedge/degrade decisions unreplayable and
+# untestable (tests drive these subsystems tick by tick).
+WALL_CLOCK_SCOPE = ("raft_tpu/serve/", "raft_tpu/lifecycle/")
+WALL_CLOCK_CALLS = {"time.time", "time.monotonic", "time.perf_counter",
+                    "time.sleep"}
 
 # The one allowed home of merge/pad sentinel literals ...
 SENTINEL_HOME = "raft_tpu/core/sentinels.py"
@@ -1351,6 +1368,34 @@ class Analyzer:
                             "-1 pad sentinel in constant_values — use "
                             "raft_tpu.core.sentinels.PAD_ID")
 
+    # -- wall-clock --------------------------------------------------------
+    def run_wall_clock(self, mods=None) -> None:
+        """serve/ and lifecycle/ must read the injected clock: a direct
+        ``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()``
+        / ``time.sleep()`` CALL in a scheduling, health, or hedging
+        decision is unreplayable and unfakeable in tests.  Referencing
+        ``time.monotonic`` without calling it (the constructor default
+        that IS the injection point) is legal — only Call nodes flag."""
+        for mod in (mods if mods is not None else self.modules.values()):
+            if not any(mod.rel.startswith(p) for p in WALL_CLOCK_SCOPE):
+                continue
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = _dotted_expr(node.func)
+                if not dotted:
+                    continue
+                head, _, rest = dotted.partition(".")
+                resolved = mod.imports.get(head, head)
+                if rest:
+                    resolved = f"{resolved}.{rest}"
+                if resolved in WALL_CLOCK_CALLS:
+                    self.report(
+                        mod, node.lineno, "wall-clock",
+                        f"direct {resolved}() call — serve/ and "
+                        f"lifecycle/ read the injected clock (pass "
+                        f"clock=/monotonic=/sleep= through instead)")
+
     # -- recompile-risk ----------------------------------------------------
     def run_recompile_risk(self) -> None:
         """Eager (untraced) code that materializes a device value to a
@@ -1584,6 +1629,8 @@ class Analyzer:
             self.run_lock(mods)
         if "sentinel" in checks:
             self.run_sentinel(mods)
+        if "wall-clock" in checks:
+            self.run_wall_clock(mods)
         if "recompile-risk" in checks:
             self.run_recompile_risk()
         self.waived.sort(key=lambda f: (f.rel, f.line, f.check, f.msg))

@@ -10,6 +10,7 @@ from raft_tpu.comms.comms import (
     inject_comms_on_handle,
 )
 from raft_tpu.comms.health import (
+    LatencyPolicy,
     ShardHealth,
     checked_sync,
 )
@@ -42,7 +43,8 @@ from raft_tpu.comms.comms_test import (
 
 __all__ = [
     "Comms", "DatatypeT", "OpT", "StatusT", "build_comms",
-    "inject_comms_on_handle", "ShardHealth", "checked_sync",
+    "inject_comms_on_handle", "LatencyPolicy", "ShardHealth",
+    "checked_sync",
     "MERGE_ENGINES", "PIPELINED_ENGINES", "merge_comm_bytes",
     "merge_parts", "pipeline_chunk_bounds", "resolve_merge_engine",
     "resolve_pipeline_chunks", "topk_merge", "topk_merge_pipelined",

@@ -27,6 +27,7 @@ committed epoch whose contents are half-applied.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
 import jax
@@ -85,6 +86,16 @@ def _tombstone(indices, list_sizes, deleted, del_ids, primary=None):
     return deleted | newly, jnp.sum(counted)
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _pad_ids(ids, width):
+    """Pow2-pad a delete-id batch on device.  Jitted so ``PAD_ID`` is a
+    baked constant, not an eager host scalar — an eager ``jnp.pad``
+    would trip the sanitizer lane's transfer guard on the int32[]
+    constant-value transfer."""
+    return jnp.pad(ids, (0, width - ids.shape[0]),
+                   constant_values=PAD_ID)
+
+
 def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
     """Delete-id batch as a device array: pow2-padded with ``PAD_ID``
     (never matches a live slot — live ids are >= 0), replicated over the
@@ -97,12 +108,13 @@ def _prepare_ids(index, ids, mesh) -> Optional[jax.Array]:
             int(raw.min()))
     width = next_pow2(int(raw.size))
     dtype = np.dtype(index.indices.dtype)
-    padded = np.full((width,), PAD_ID, dtype)  # analyze: host-sync-ok (eager host-side id padding, once per delete batch — never inside a compiled program)
-    padded[:raw.size] = raw.astype(dtype)
+    # Pad on DEVICE: the ids transfer once (explicit asarray), and the
+    # WAL replay path (wal.apply_record -> _delete) never materializes
+    # a host-side staging buffer per batch.
+    dev = _pad_ids(jnp.asarray(raw.astype(dtype)), width)
     if _is_sharded(index):
-        return jax.device_put(jnp.asarray(padded),
-                              NamedSharding(mesh, P()))
-    return jnp.asarray(padded)
+        return jax.device_put(dev, NamedSharding(mesh, P()))
+    return dev
 
 
 def _primary_mask(index, mesh):

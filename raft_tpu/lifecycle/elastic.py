@@ -125,6 +125,15 @@ def _resize(searcher, rank: int, join: bool, grid=None) -> ElasticReport:
             "rank %s outside the mesh's %s shards — the JAX device set "
             "is fixed per process; elastic membership moves lists "
             "across it", rank, pm.n_dev)
+    # Health gate (no-silent-revive): a resize must not quietly pull a
+    # dead or suspect shard back into the serving set — re-admission is
+    # mark_live's job (serve/recovery.py), an explicit observed edge.
+    health = getattr(searcher, "health", None)
+    if health is not None:
+        expects(not join or health.state(rank) == "live",
+                "shard %s is %s — re-admit it via mark_live (after "
+                "recovery probes) before joining it back", rank,
+                health.state(rank) if hasattr(health, "state") else "?")
     before = set(serving_shards(index))
     active = set(before)
     if join:
@@ -146,10 +155,21 @@ def _resize(searcher, rank: int, join: bool, grid=None) -> ElasticReport:
                              active=sorted(active))
     # Replicas re-place against a live set that excludes a leaver —
     # migrate-out must not park the fault-tolerance copy on the shard
-    # being retired.
+    # being retired.  The same mask excludes DEAD and SUSPECT members
+    # (when the searcher carries a health registry): a replica parked
+    # on a straggler would strand the fault-tolerance copy exactly
+    # where hedges are already routing away from.
     live = np.ones(pm.n_dev, bool)
+    if health is not None:
+        live &= np.asarray(health.live_mask, bool)
+        live &= ~np.asarray(health.suspect_mask, bool)
+        live[rank] = join   # the joiner is (checked) live; a leaver is out
     if not join:
         live[rank] = False
+    if not live.any():
+        live = np.ones(pm.n_dev, bool)   # degenerate: keep old behavior
+        if not join:
+            live[rank] = False
     successor, n_moved = sharded_migrate_lists(searcher.mesh, index,
                                                new_owner, live_mask=live)
 

@@ -26,11 +26,14 @@ zero implicit transfers and zero recompiles).
 
 from raft_tpu.obs.recall import RecallProbe
 from raft_tpu.obs.registry import (
+    BreakerCollector,
     CacheCollector,
     CompactorCollector,
     Counter,
+    DegradeCollector,
     ElasticCollector,
     Gauge,
+    HedgeCollector,
     Histogram,
     MergeDispatchCollector,
     MetricsRegistry,
@@ -48,5 +51,6 @@ __all__ = [
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
     "RoutingCollector", "WalCollector", "ElasticCollector",
+    "HedgeCollector", "BreakerCollector", "DegradeCollector",
     "RecallProbe",
 ]

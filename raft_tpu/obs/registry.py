@@ -40,6 +40,7 @@ __all__ = [
     "ServeStatsCollector", "ShardHealthCollector", "CacheCollector",
     "CompactorCollector", "SearcherCollector", "MergeDispatchCollector",
     "RoutingCollector", "WalCollector", "ElasticCollector",
+    "HedgeCollector", "BreakerCollector", "DegradeCollector",
 ]
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -374,9 +375,12 @@ class ServeStatsCollector:
 
 
 class ShardHealthCollector:
-    """``ShardHealth`` → per-rank liveness gauge + transition events
-    (comms/health.py).  Transitions are counted by a registered
-    listener, so a die+revive BETWEEN scrapes still shows."""
+    """``ShardHealth`` → per-rank liveness/suspect gauges + transition
+    events (comms/health.py).  Transitions are counted by registered
+    listeners, so a die+revive BETWEEN scrapes still shows.  The
+    three-state feed (``add_state_listener``) counts suspect edges the
+    binary channel hides — a shard that went suspect, was hedged
+    around, and recovered between scrapes leaves its trail here."""
 
     def __init__(self, registry: MetricsRegistry, health,
                  prefix: str = "raft_shard"):
@@ -384,27 +388,51 @@ class ShardHealthCollector:
         self._live = registry.gauge(
             prefix + "_live", "per-rank liveness (1 live / 0 dead)",
             labels=("rank",))
+        self._suspect = registry.gauge(
+            prefix + "_suspect",
+            "per-rank suspect flag (1 = latency outlier, hedged around)",
+            labels=("rank",))
         self._n_live = registry.gauge(
             prefix + "_n_live", "count of live ranks")
+        self._n_suspect = registry.gauge(
+            prefix + "_n_suspect", "count of suspect ranks")
         self._transitions = registry.counter(
             prefix + "_transitions_total",
             "live/dead state transitions per rank",
             labels=("rank", "to"))
+        self._state_transitions = registry.counter(
+            prefix + "_state_transitions_total",
+            "full three-state transitions per rank (incl. suspect)",
+            labels=("rank", "to"))
         self._unsub_listener = health.add_listener(self._on_transition)
+        self._unsub_state = (
+            health.add_state_listener(self._on_state)
+            if hasattr(health, "add_state_listener") else None)
         self._unsub = registry.register_collector(self.collect)
 
     def _on_transition(self, rank: int, live: bool) -> None:
         self._transitions.inc(rank=rank, to="live" if live else "dead")
 
+    def _on_state(self, rank: int, state: str) -> None:
+        self._state_transitions.inc(rank=rank, to=state)
+
     def collect(self) -> None:
         mask = self.health.live_mask
+        suspect = getattr(self.health, "suspect_mask", None)
         for rank, live in enumerate(mask):
             self._live.set(1.0 if live else 0.0, rank=rank)
+            if suspect is not None:
+                self._suspect.set(1.0 if suspect[rank] else 0.0,
+                                  rank=rank)
         self._n_live.set(float(mask.sum()))
+        if suspect is not None:
+            self._n_suspect.set(float(suspect.sum()))
 
     def close(self) -> None:
         self._unsub()
         self._unsub_listener()
+        if self._unsub_state is not None:
+            self._unsub_state()
 
 
 class CacheCollector:
@@ -687,6 +715,105 @@ class ElasticCollector:
         self._leaves.set_total(snap["leaves"])
         self._moved.set_total(snap["lists_moved"])
         self._epoch.set(snap["last_epoch"])
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class HedgeCollector:
+    """Hedged-dispatch telemetry (serve/hedge.py ``HedgeStats`` on the
+    Searcher): hedges fired / won / suppressed, plus the routing
+    layer's suspect-avoided count — together the scrape answer to "is
+    the tail defense actually engaging, and is it winning?"."""
+
+    def __init__(self, registry: MetricsRegistry, searcher,
+                 prefix: str = "raft_hedge"):
+        self.searcher = searcher
+        self._counters = {
+            c: registry.counter(
+                "%s_%s_total" % (prefix, c), "hedged dispatches %s" % c)
+            for c in ("fired", "won", "suppressed")}
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        stats = getattr(self.searcher, "hedge_stats", None)
+        if stats is None:
+            return
+        snap = stats.snapshot()
+        for c, metric in self._counters.items():
+            metric.set_total(snap[c])
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class BreakerCollector:
+    """Circuit-breaker telemetry (serve/recovery.py
+    :class:`RecoveryProber`): per-rank breaker state gauge (0 closed /
+    1 half_open / 2 open), clean-probe streaks, probes sent/clean, and
+    re-admissions — the scrape proof that a dead shard is being probed
+    back instead of silently revived."""
+
+    _STATE_CODE = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+
+    def __init__(self, registry: MetricsRegistry, prober,
+                 prefix: str = "raft_breaker"):
+        self.prober = prober
+        self._state = registry.gauge(
+            prefix + "_state",
+            "per-rank breaker state (0 closed / 1 half_open / 2 open)",
+            labels=("rank",))
+        self._streak = registry.gauge(
+            prefix + "_clean_streak",
+            "consecutive clean shadow probes per rank",
+            labels=("rank",))
+        self._probes = registry.counter(
+            prefix + "_probes_total", "shadow probes sent")
+        self._clean = registry.counter(
+            prefix + "_probes_clean_total", "shadow probes judged clean")
+        self._readmissions = registry.counter(
+            prefix + "_readmissions_total",
+            "ranks re-admitted via mark_live after a full clean streak")
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        snap = self.prober.snapshot()
+        for rank, state in snap["states"].items():
+            self._state.set(self._STATE_CODE[state], rank=rank)
+        for rank, streak in snap["streaks"].items():
+            self._streak.set(float(streak), rank=rank)
+        self._probes.set_total(snap["probes_sent"])
+        self._clean.set_total(snap["probes_clean"])
+        self._readmissions.set_total(snap["readmissions"])
+
+    def close(self) -> None:
+        self._unsub()
+
+
+class DegradeCollector:
+    """Degradation-ladder telemetry (serve/scheduler.py
+    :class:`DegradePolicy`): the scheduler's current brownout rung and
+    queue fill fraction.  The per-bucket served-quality counters
+    (``served_full`` / ``served_reduced`` / ``served_brownout``,
+    ``probes_shrunk``, ``priority_evictions``) already flow through
+    :class:`ServeStatsCollector` — this adapter adds the point-in-time
+    gauges a dashboard alerts on."""
+
+    def __init__(self, registry: MetricsRegistry, scheduler,
+                 prefix: str = "raft_degrade"):
+        self.scheduler = scheduler
+        self._level = registry.gauge(
+            prefix + "_brownout_level",
+            "ladder rung of the most recent dispatch (0 = full quality)")
+        self._fill = registry.gauge(
+            prefix + "_queue_fill",
+            "queued requests / max_queue at scrape time")
+        self._unsub = registry.register_collector(self.collect)
+
+    def collect(self) -> None:
+        sched = self.scheduler
+        self._level.set(float(getattr(sched, "brownout_level", 0)))
+        self._fill.set(sched.pending() / sched.policy.max_queue)
 
     def close(self) -> None:
         self._unsub()

@@ -35,6 +35,7 @@ from raft_tpu.parallel.routing import (
     RoutingStats,
     assign_lists,
     build_placement,
+    participant_ranks,
     plan_route,
     route_shapes,
     routing_stats,
@@ -52,5 +53,6 @@ __all__ = [
     "sharded_migrate_lists", "sharded_replicate_lists",
     "sharded_routed_warmup",
     "ListPlacement", "RoutePlan", "RoutingStats", "assign_lists",
-    "build_placement", "plan_route", "route_shapes", "routing_stats",
+    "build_placement", "participant_ranks", "plan_route", "route_shapes",
+    "routing_stats",
 ]

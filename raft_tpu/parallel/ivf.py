@@ -387,6 +387,7 @@ def sharded_ivf_flat_search(
     mesh: Mesh, params: "_flat.SearchParams", index: ShardedIvfFlat,
     queries, k: int, merge_engine: str = "auto", live_mask=None,
     pipeline_chunks: int = 0, _plan=None, valid_rows=None,
+    suspect_mask=None, plan_cb=None,
 ):
     """Search the sharded index; returns replicated global-id results,
     identical to the single-device index built from the same centers.
@@ -421,8 +422,13 @@ def sharded_ivf_flat_search(
     stay bit-identical to this row-sharded path.  Under a ``live_mask``
     liveness becomes a routing input: dead shards receive no queries,
     live replicas keep hot lists served, and ``coverage`` prices the
-    lists with no live owner.  ``_plan`` injects a pre-built RoutePlan
-    (the :func:`sharded_routed_warmup` vehicle)."""
+    lists with no live owner.  ``suspect_mask`` makes latency one too
+    (routed only): a suspect primary with a healthy replica serves
+    through the replica (parallel/routing.plan_route).  ``plan_cb`` is
+    called with each router-built RoutePlan — how the Searcher learns
+    the dispatch's participants for latency attribution and hedging.
+    ``_plan`` injects a pre-built RoutePlan (the
+    :func:`sharded_routed_warmup` vehicle)."""
     Q = replicated(mesh, _flat._as_float(_flat.as_array(queries)))
     # Model tensors place replicated ONCE (write-back): the un-placed
     # single-device centers would otherwise re-transfer at every jit
@@ -433,7 +439,9 @@ def sharded_ivf_flat_search(
         return _routed_flat_search(mesh, params, index, Q, k,
                                    merge_engine, live_mask,
                                    pipeline_chunks, plan=_plan,
-                                   valid_rows=valid_rows)
+                                   valid_rows=valid_rows,
+                                   suspect_mask=suspect_mask,
+                                   plan_cb=plan_cb)
     n_probes = min(params.n_probes, index.centers.shape[0])
     # Clamp by the GLOBAL capacity (n_dev shards merge their top-k), the
     # same contract as the single-device search's capacity clamp.
@@ -514,13 +522,14 @@ def _routed_sizes_h(index) -> np.ndarray:
 
 
 def _routed_plan(mesh, index, Q, probe_fn, live_mask,
-                 valid_rows=None) -> RoutePlan:
+                 valid_rows=None, suspect_mask=None) -> RoutePlan:
     """Route one batch: probe on device, read the assignments back (the
     routed path's one declared device→host boundary — the router is
     host-side by design), plan in numpy, record the routing telemetry.
     ``valid_rows`` marks the real rows of a shape-bucketed batch (the
     scheduler's zero padding routes nowhere and stays out of the
-    telemetry)."""
+    telemetry); ``suspect_mask`` steers hot lists off slow-but-live
+    shards (plan_route)."""
     n_dev = mesh.shape[index.axis]
     live = None
     if live_mask is not None:
@@ -528,13 +537,15 @@ def _routed_plan(mesh, index, Q, probe_fn, live_mask,
         # never a collective operand (dead shards receive no queries).
         check_live_mask(live_mask, n_dev)
         live = np.asarray(live_mask).astype(bool)
+    suspect = (None if suspect_mask is None
+               else np.asarray(suspect_mask).astype(bool))
     # analyze: host-sync-ok (routed dispatch: the router reads the probe
     # assignments back by design; one declared device_get per batch)
     probe_h = np.asarray(jax.device_get(probe_fn(Q, index.centers)))
     plan = plan_route(
         probe_h, index.placement_map, live_mask=live,
         list_sizes=_routed_sizes_h(index) if live is not None else None,
-        n_valid=valid_rows)
+        n_valid=valid_rows, suspect_mask=suspect)
     routing_stats.record(
         plan, index.placement_map,
         probe_ids=probe_h if valid_rows is None else probe_h[:valid_rows])
@@ -590,21 +601,26 @@ def _scatter_back(d_g, i_g, rows_l, n_q: int, select_min: bool):
 
 def _routed_prelude(mesh, index, Q, k: int, merge_engine, live_mask,
                     pipeline_chunks: int, probe_fn, plan,
-                    valid_rows=None):
+                    valid_rows=None, suspect_mask=None, plan_cb=None):
     """The shared route→resolve→account prelude of both routed entry
     points (one definition so participant accounting and chunk-width
     resolution cannot drift between the flat and PQ paths): clamp k,
     build (or accept) the plan, resolve the engine + pipeline chunks
     over the plan's LOCAL probe width, and record the one logical
     merge for the participating shards — telemetry skipped for
-    injected (warmup) plans.  Returns ``(k, plan, engine, chunks)``."""
+    injected (warmup) plans, which also bypass ``plan_cb`` (the
+    Searcher's participation feed covers real dispatches only).
+    Returns ``(k, plan, engine, chunks)``."""
     n_dev = mesh.shape[index.axis]
     cap = index.indices.shape[2]
     k = min(k, index.placement_map.n_lists * cap)
     warm = plan is not None
     if not warm:
         plan = _routed_plan(mesh, index, Q, probe_fn, live_mask,
-                            valid_rows=valid_rows)
+                            valid_rows=valid_rows,
+                            suspect_mask=suspect_mask)
+        if plan_cb is not None:
+            plan_cb(plan)
     engine = resolve_merge_engine(merge_engine, Q.shape[0], k, n_dev,
                                   n_probes=plan.pb)
     chunks = tuple(pipeline_chunk_bounds(
@@ -712,7 +728,8 @@ def _routed_flat_search_jit(data, indices, sizes, Q, q_rows, probe_slots,
 
 def _routed_flat_search(mesh, params, index, Q, k: int, merge_engine,
                         live_mask, pipeline_chunks: int, plan=None,
-                        valid_rows=None):
+                        valid_rows=None, suspect_mask=None,
+                        plan_cb=None):
     """Route → dispatch → sparse merge for the list-owned IVF-Flat.
     ``plan`` injects a pre-built (typically all-padding) RoutePlan —
     the warmup vehicle (:func:`sharded_routed_warmup`); telemetry is
@@ -725,7 +742,8 @@ def _routed_flat_search(mesh, params, index, Q, k: int, merge_engine,
         mesh, index, Q, k, merge_engine, live_mask, pipeline_chunks,
         functools.partial(_routed_probe_flat, n_probes=n_probes,
                           inner_is_l2=inner_is_l2), plan,
-        valid_rows=valid_rows)
+        valid_rows=valid_rows, suspect_mask=suspect_mask,
+        plan_cb=plan_cb)
     use_cells = _flat._cells_eligible(
         params.engine, k, params.bucket_cap, index.indices.shape[2],
         index.centers.shape[1], plan.qg, plan.pb,
@@ -884,7 +902,7 @@ def _routed_pq_compressed_jit(codesT, invalid, indices, crot_p_slot,
 
 def _routed_pq_search(mesh, params, index, Q, k: int, merge_engine,
                       live_mask, pipeline_chunks: int, plan=None,
-                      valid_rows=None):
+                      valid_rows=None, suspect_mask=None, plan_cb=None):
     """Route → dispatch → sparse merge for the list-owned IVF-PQ (both
     tiers; tier dispatch mirrors the row-sharded entry with the routed
     group/probe widths)."""
@@ -895,7 +913,8 @@ def _routed_pq_search(mesh, params, index, Q, k: int, merge_engine,
     k, plan, engine, chunks = _routed_prelude(
         mesh, index, Q, k, merge_engine, live_mask, pipeline_chunks,
         functools.partial(_routed_probe_pq, n_probes=n_probes,
-                          is_ip=is_ip), plan, valid_rows=valid_rows)
+                          is_ip=is_ip), plan, valid_rows=valid_rows,
+        suspect_mask=suspect_mask, plan_cb=plan_cb)
     q_rows, probe_slots = _routed_operands(mesh, index, plan)
     default_dtypes = (lut_dtype == jnp.float32
                       and internal_dtype == jnp.float32)
@@ -1182,6 +1201,7 @@ def sharded_ivf_pq_search(
     mesh: Mesh, params: "_pq.SearchParams", index: ShardedIvfPq,
     queries, k: int, merge_engine: str = "auto", live_mask=None,
     pipeline_chunks: int = 0, _plan=None, valid_rows=None,
+    suspect_mask=None, plan_cb=None,
 ):
     """Search the sharded PQ index; returns replicated global-id results.
 
@@ -1218,7 +1238,9 @@ def sharded_ivf_pq_search(
     if index.placement == "list":
         return _routed_pq_search(mesh, params, index, Q, k, merge_engine,
                                  live_mask, pipeline_chunks, plan=_plan,
-                                 valid_rows=valid_rows)
+                                 valid_rows=valid_rows,
+                                 suspect_mask=suspect_mask,
+                                 plan_cb=plan_cb)
     lut_dtype, internal_dtype = _pq.validate_search_dtypes(params)
     n_probes = min(params.n_probes, index.centers.shape[0])
     k = min(k, index.indices.shape[0] * index.indices.shape[1]

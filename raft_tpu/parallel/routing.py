@@ -258,6 +258,9 @@ class RoutePlan:
     coverage: Optional[np.ndarray] = None   # (n_queries,) float32
     # Real (non-padding) rows of a shape-bucketed batch; None = all.
     n_valid: Optional[int] = None
+    # Probe occurrences steered off a suspect primary onto a healthy
+    # replica (suspect_mask routing input; 0 when not consulted).
+    suspect_avoided: int = 0
 
 
 def route_shapes(n_queries: int, n_probes: int
@@ -294,7 +297,8 @@ def empty_plan(placement: ListPlacement, n_queries: int, qg: int,
 
 def plan_route(probe_ids: np.ndarray, placement: ListPlacement,
                live_mask=None, list_sizes=None,
-               n_valid: Optional[int] = None) -> RoutePlan:
+               n_valid: Optional[int] = None,
+               suspect_mask=None) -> RoutePlan:
     """Map a batch's probe assignments to per-shard query groups.
 
     ``probe_ids`` — host (n_queries, n_probes) int32, the SAME coarse
@@ -307,6 +311,14 @@ def plan_route(probe_ids: np.ndarray, placement: ListPlacement,
     and a list with no live owner drops out as coverage loss.
     ``list_sizes`` (host (n_lists,) rows per list) prices the coverage
     fractions; required when ``live_mask`` is given.
+
+    ``suspect_mask`` makes LATENCY a routing input
+    (comms.health.ShardHealth.suspect_mask): a suspect primary with a
+    live non-suspect replica serves this batch through the replica,
+    and both-live read balancing only spreads across pairs where both
+    copies are healthy (one suspect copy pins the list to the healthy
+    one).  A suspect shard with no stand-in still serves — suspect is
+    a preference, never a coverage loss.
 
     ``n_valid`` marks a shape-bucketed batch: rows at or past it are
     the scheduler's zero padding — they are routed NOWHERE (no shard
@@ -337,10 +349,28 @@ def plan_route(probe_ids: np.ndarray, placement: ListPlacement,
         prim_live = np.ones(placement.n_lists, bool)
         rep = placement.replica_owner
         rep_live = rep >= 0
+    if suspect_mask is not None:
+        suspect = np.asarray(suspect_mask, bool)
+        expects(suspect.shape == (n_dev,),
+                "suspect_mask must be (%s,), got %s", n_dev,
+                suspect.shape)
+    else:
+        suspect = np.zeros(n_dev, bool)
+    prim_susp = prim_live & suspect[placement.owner]
+    rep_susp = rep_live & suspect[np.maximum(rep, 0)]
+    # Suspect avoidance: a live-but-slow primary with a healthy live
+    # replica serves through the replica (suspect != unreachable — a
+    # suspect-only copy still serves at full coverage).
+    prefer_rep = prim_susp & rep_live & ~rep_susp
+    serving = np.where(prefer_rep, rep, serving)
+    suspect_avoided = int(occ[prefer_rep].sum())
     # Replica read balancing: lists live on BOTH copies route this
     # batch's occurrences to the lighter shard — hot lists are why the
     # replica exists.  Descending-occurrence greedy, deterministic.
-    both = np.flatnonzero(prim_live & rep_live & (occ > 0))
+    # Only both-HEALTHY pairs balance: one suspect copy pins the list
+    # to the other.
+    both = np.flatnonzero(prim_live & ~prim_susp & rep_live & ~rep_susp
+                          & (occ > 0))
     if both.size:
         loads = np.zeros(n_dev, np.int64)
         single = np.ones(placement.n_lists, bool)
@@ -405,7 +435,15 @@ def plan_route(probe_ids: np.ndarray, placement: ListPlacement,
         n_queries=n_q, participants=int(part.any(axis=1).sum()),
         fanout_mean=float(part.sum()) / max(n_real, 1),
         replica_hits=replica_hits, coverage=coverage,
-        n_valid=None if n_valid is None else n_real)
+        n_valid=None if n_valid is None else n_real,
+        suspect_avoided=suspect_avoided)
+
+
+def participant_ranks(plan: RoutePlan) -> np.ndarray:
+    """The shard ranks a plan routes >= 1 query to — the per-dispatch
+    participation set the Searcher attributes latency observations to
+    (``ShardHealth.observe_latency``) and hands chaos rank hooks."""
+    return np.flatnonzero((plan.q_rows < plan.n_queries).any(axis=1))
 
 
 class RoutingStats(SuppressibleStats):
@@ -439,6 +477,7 @@ class RoutingStats(SuppressibleStats):
         self.queries = 0
         self.fanout_sum = 0.0
         self.replica_hits = 0
+        self.suspect_avoided = 0
 
     def record(self, plan: RoutePlan, placement: ListPlacement,
                probe_ids=None) -> None:
@@ -451,6 +490,7 @@ class RoutingStats(SuppressibleStats):
             self.queries += real
             self.fanout_sum += plan.fanout_mean * real
             self.replica_hits += plan.replica_hits
+            self.suspect_avoided += plan.suspect_avoided
             empty = placement.empty_slot
             for s in range(placement.n_dev):
                 routed = int((plan.q_rows[s] < plan.n_queries).sum())
@@ -502,6 +542,7 @@ class RoutingStats(SuppressibleStats):
                 "queries": self.queries,
                 "fanout_mean": mean,
                 "replica_hits": self.replica_hits,
+                "suspect_avoided": self.suspect_avoided,
                 "shard_queries": dict(self._shard_queries),
                 "shard_probes": dict(self._shard_probes),
                 "lists_owned": dict(self._lists_owned),
@@ -518,6 +559,7 @@ class RoutingStats(SuppressibleStats):
             self.queries = 0
             self.fanout_sum = 0.0
             self.replica_hits = 0
+            self.suspect_avoided = 0
 
 
 #: Process-wide recorder the routed entry points feed (scraped via

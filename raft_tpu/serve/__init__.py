@@ -2,11 +2,13 @@
 
 The orchestration layer that turns the one-shot sharded search calls
 into a service: shape-bucketed compilation (``bucketing``), dynamic
-micro-batching with bounded-queue admission control and deadlines
-(``scheduler``), an exact-query LRU result cache keyed by index epoch
-(``cache``), a uniform searcher facade threading merge_engine /
-ShardHealth / RetryPolicy (``searcher``), and per-bucket serving stats
-(``stats``). See docs/serving.md.
+micro-batching with bounded-queue admission control, deadlines and the
+degradation ladder (``scheduler``), an exact-query LRU result cache
+keyed by index epoch (``cache``), a uniform searcher facade threading
+merge_engine / ShardHealth / RetryPolicy / hedged replica dispatch
+(``searcher``, ``hedge``), circuit-breaker shard re-admission
+(``recovery``), and per-bucket serving stats (``stats``). See
+docs/serving.md and docs/fault_tolerance.md.
 """
 
 from raft_tpu.serve.bucketing import (
@@ -16,9 +18,12 @@ from raft_tpu.serve.bucketing import (
     warmup,
 )
 from raft_tpu.serve.cache import ResultCache
+from raft_tpu.serve.hedge import HedgePolicy, HedgeStats
+from raft_tpu.serve.recovery import RecoveryProber
 from raft_tpu.serve.scheduler import (
     BatchPolicy,
     BatchScheduler,
+    DegradePolicy,
     Overloaded,
     Ticket,
 )
@@ -28,7 +33,10 @@ from raft_tpu.serve.stats import CompileCounter, ServeStats
 __all__ = [
     "BucketGrid", "DEFAULT_K_GRID", "pad_queries", "warmup",
     "ResultCache",
-    "BatchPolicy", "BatchScheduler", "Overloaded", "Ticket",
+    "HedgePolicy", "HedgeStats",
+    "RecoveryProber",
+    "BatchPolicy", "BatchScheduler", "DegradePolicy", "Overloaded",
+    "Ticket",
     "Searcher", "SearchResult",
     "CompileCounter", "ServeStats",
 ]

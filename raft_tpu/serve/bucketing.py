@@ -28,7 +28,7 @@ ladder at <2x and tracked per bucket as ``padded_slots`` in
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as _dc_replace
 from typing import Optional, Tuple
 
 import numpy as np
@@ -130,7 +130,9 @@ def pad_queries(queries: np.ndarray, q_bucket: int) -> np.ndarray:
 
 
 def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
-           cache_dir: Optional[str] = None) -> dict:
+           cache_dir: Optional[str] = None,
+           degrade_ladder: Optional[Tuple[float, ...]] = None,
+           min_probes: int = 1) -> dict:
     """Pre-compile every bucket shape through the persistent compilation
     cache, so steady-state in-grid traffic never compiles.
 
@@ -152,7 +154,15 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
     routed traffic then never compiles regardless of how queries
     cluster.  The routed program is liveness-FREE (liveness is a
     routing input, not an operand), so ``include_degraded`` adds no
-    extra routed traces."""
+    extra routed traces.
+
+    ``degrade_ladder`` (pass ``DegradePolicy.ladder`` + its
+    ``min_probes``) additionally warms every reduced-``n_probes`` rung
+    the deadline degradation ladder can serve at: ``n_probes`` is a
+    STATIC jit argument, so a brownout that shrank it to an un-warmed
+    value would compile in the hot path — exactly when latency is
+    already collapsing.  Ignored for searchers without an ``n_probes``
+    parameter (brute force)."""
     from raft_tpu.core.compilation_cache import enable_compilation_cache
     from raft_tpu.core.logger import logger
     from raft_tpu.serve.stats import CompileCounter
@@ -166,6 +176,14 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
     effective_dir = enable_compilation_cache(cache_dir)
     dim = searcher.dim
     shapes = grid.shapes()
+    # The ladder's closed n_probes set (deduped: min_probes and int
+    # truncation can collapse adjacent rungs onto one value).
+    base_np = getattr(getattr(searcher, "_params", None), "n_probes", None)
+    rung_probes: Tuple[int, ...] = ()
+    if degrade_ladder is not None and base_np is not None:
+        vals = {max(int(min_probes), int(int(base_np) * float(f)))
+                for f in degrade_ladder}
+        rung_probes = tuple(sorted(v for v in vals if v < int(base_np)))
     routed = (getattr(searcher, "mesh", None) is not None
               and getattr(getattr(searcher, "_index", None),
                           "placement", "row") == "list")
@@ -195,15 +213,29 @@ def warmup(searcher, grid: BucketGrid, include_degraded: bool = False,
             searcher.search(dummy, kb, degraded=False)
             if include_degraded:
                 searcher.search(dummy, kb, degraded=True)
+            for npr in rung_probes:
+                # One extra trace per ladder rung per shape: brownout
+                # serving then reuses these instead of compiling.
+                searcher.search(dummy, kb, degraded=False, n_probes=npr)
+                if include_degraded:
+                    searcher.search(dummy, kb, degraded=True,
+                                    n_probes=npr)
             if routed:
                 from raft_tpu.parallel.ivf import sharded_routed_warmup
 
                 routed_shapes += sharded_routed_warmup(
                     searcher.mesh, searcher._params, searcher._index,
                     qb, kb, merge_engine=searcher.merge_engine)
+                for npr in rung_probes:
+                    routed_shapes += sharded_routed_warmup(
+                        searcher.mesh,
+                        _dc_replace(searcher._params, n_probes=npr),
+                        searcher._index, qb, kb,
+                        merge_engine=searcher.merge_engine)
     logger.debug("serve warmup: %s bucket shapes (+%s routed plan "
                  "shapes), %s XLA compiles, cache at %s", len(shapes),
                  routed_shapes, counter.count, effective_dir)
     return {"shapes": len(shapes), "degraded": bool(include_degraded),
             "routed_shapes": routed_shapes,
+            "degrade_rungs": len(rung_probes),
             "compile_events": counter.count, "cache_dir": effective_dir}

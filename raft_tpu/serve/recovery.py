@@ -1,0 +1,151 @@
+"""Circuit-breaker recovery: shadow-probe degraded shards back to life.
+
+Ref pattern: the reference's comms layer has no re-admission story — a
+rank that fails is gone for the session.  PR 12 added explicit
+``mark_live`` revival (no-silent-revive: nothing re-admits a shard as a
+side effect), but deciding WHEN to call it was left to the operator.
+This module closes the loop with the classic circuit-breaker shape
+(Nygard, "Release It!"): a dead or suspect shard's breaker is *open*
+(no serving traffic — routing already steers around it), the
+:class:`RecoveryProber` periodically sends it shadow probes off the hot
+path (``Searcher.shadow_probe`` — suppressed stats, no health feedback,
+no caller traffic), and only after ``clean_threshold`` CONSECUTIVE
+clean probes does it *close* the breaker via ``health.mark_live`` — an
+explicit, observed edge on the listener surface, with the warmed trace
+intact (re-admission compiles nothing: the routed lattice was warmed
+for the full fleet).
+
+Flap safety: ANY probe failure — an exception, or a probe slower than
+``budget`` — resets the streak to zero, and so does a fresh dead or
+suspect transition between probing passes (the prober subscribes to the
+state-listener feed).  A flapping shard therefore never serves until it
+has proven ``clean_threshold`` consecutive clean probes; there is no
+half-credit.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from raft_tpu.core.error import expects
+from raft_tpu.core.logger import logger
+
+__all__ = ["RecoveryProber"]
+
+
+class RecoveryProber:
+    """Re-admit dead/suspect shards after consecutive clean shadow probes.
+
+    Step-driven like the BatchScheduler: ``step()`` runs one probing
+    pass over every degraded rank (a driver loop owns the cadence; the
+    prober never sleeps and never reads wall time — elapsed comes from
+    the Searcher's injected clock via :meth:`Searcher.shadow_probe`).
+
+    Breaker states per rank (``state(rank)`` / ``snapshot()``):
+
+    * ``"closed"``  — rank is live and not suspect; traffic flows.
+    * ``"open"``    — rank is degraded with no clean-probe credit.
+    * ``"half_open"`` — rank is degraded but mid-streak: some clean
+      probes passed, fewer than ``clean_threshold``.
+    """
+
+    def __init__(self, searcher, health, queries, k: int = 4, *,
+                 clean_threshold: int = 3,
+                 budget: Optional[float] = None):
+        expects(clean_threshold >= 1,
+                "clean_threshold must be >= 1, got %s", clean_threshold)
+        expects(budget is None or budget > 0.0,
+                "budget must be positive seconds, got %s", budget)
+        q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
+        expects(q.ndim == 2 and q.shape[0] >= 1,
+                "probe queries must be (n, dim), got %s", q.shape)
+        self.searcher = searcher
+        self.health = health
+        self.queries = q
+        self.k = int(k)
+        self.clean_threshold = int(clean_threshold)
+        self.budget = budget
+        self._streak: Dict[int, int] = {}
+        self.probes_sent = 0
+        self.probes_clean = 0
+        self.readmissions = 0
+        # A fresh degradation between probing passes voids any streak:
+        # a flapping shard starts its proof over from zero every flap.
+        self._unsub = health.add_state_listener(self._on_transition)
+
+    def _on_transition(self, rank: int, state: str) -> None:
+        if state in ("dead", "suspect"):
+            self._streak[rank] = 0
+
+    # -- probing -----------------------------------------------------------
+    def step(self) -> List[int]:
+        """One probing pass: shadow-probe every degraded rank once and
+        re-admit those whose clean streak reaches ``clean_threshold``.
+        Returns the ranks re-admitted this pass."""
+        readmitted: List[int] = []
+        for rank in range(self.health.n_ranks):
+            if self.health.state(rank) == "live":
+                continue
+            self.probes_sent += 1
+            try:
+                elapsed = self.searcher.shadow_probe(
+                    rank, self.queries, self.k)
+            except Exception as err:
+                self._streak[rank] = 0
+                logger.trace("recovery probe of rank %s failed: %r",
+                             rank, err)
+                continue
+            if self.budget is not None and elapsed > self.budget:
+                self._streak[rank] = 0   # slow probe = not clean
+                logger.trace("recovery probe of rank %s too slow: "
+                             "%.6fs > budget %.6fs", rank, elapsed,
+                             self.budget)
+                continue
+            self.probes_clean += 1
+            self._streak[rank] = self._streak.get(rank, 0) + 1
+            if self._streak[rank] >= self.clean_threshold:
+                # The ONLY automatic mark_live in the stack, and it is
+                # an explicit observed edge: listeners fire, collectors
+                # count the transition, and the rank's latency history
+                # was reset by mark_live so stale EWMA can't re-suspect.
+                self.health.mark_live(rank)
+                self._streak[rank] = 0
+                self.readmissions += 1
+                readmitted.append(rank)
+                logger.info("recovery: rank %s re-admitted after %s "
+                            "consecutive clean probes", rank,
+                            self.clean_threshold)
+        return readmitted
+
+    # -- views -------------------------------------------------------------
+    def state(self, rank: int) -> str:
+        """The rank's breaker state: closed / open / half_open."""
+        if self.health.state(rank) == "live":
+            return "closed"
+        return "half_open" if self._streak.get(rank, 0) > 0 else "open"
+
+    def snapshot(self) -> dict:
+        states = {r: self.state(r) for r in range(self.health.n_ranks)}
+        return {
+            "states": states,
+            "streaks": {r: self._streak.get(r, 0)
+                        for r in range(self.health.n_ranks)},
+            "probes_sent": self.probes_sent,
+            "probes_clean": self.probes_clean,
+            "readmissions": self.readmissions,
+        }
+
+    def close(self) -> None:
+        """Unsubscribe from the health feed. Idempotent."""
+        if self._unsub is not None:
+            self._unsub()
+            self._unsub = None
+
+    def __repr__(self) -> str:
+        s = self.snapshot()
+        n_open = sum(1 for v in s["states"].values() if v != "closed")
+        return ("RecoveryProber(degraded=%d, probes=%d/%d clean, "
+                "readmissions=%d)" % (n_open, s["probes_clean"],
+                                      s["probes_sent"], s["readmissions"]))

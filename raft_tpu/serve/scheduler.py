@@ -73,6 +73,64 @@ class BatchPolicy:
         expects(self.max_queue >= 1, "max_queue must be >= 1")
 
 
+@dataclass(frozen=True)
+class DegradePolicy:
+    """Deadline degradation ladder: shrink ``n_probes`` before shedding.
+
+    When queue pressure or a batch's remaining deadline budget undercuts
+    the per-bucket latency model (:meth:`ServeStats.latency_quantile`),
+    the scheduler steps down a ladder of probe fractions instead of
+    letting the batch miss its deadline at full depth — degrade, don't
+    drop (docs/fault_tolerance.md).  ``ladder`` is a descending tuple of
+    probe fractions; rung 0 MUST be 1.0 (full quality).  Rung quality
+    classes: rung 0 = ``"full"``, the last rung = ``"brownout"``,
+    everything between = ``"reduced"`` — every degraded answer carries
+    its class and ``degrade_reason`` on the :class:`SearchResult`.
+
+    The ladder only ever shrinks a STATIC jit argument to values from a
+    closed set — warm them ahead of traffic with
+    ``warmup(..., degrade_ladder=policy.ladder)`` so brownout never
+    pays a compile on the hot path.
+    """
+
+    ladder: tuple = (1.0, 0.5, 0.25)
+    queue_high: float = 0.5     # queue fill fraction that forces rung >= 1
+    queue_full: float = 0.9     # queue fill fraction that forces the deepest rung
+    latency_quantile: float = 0.95  # per-bucket quantile the latency model reads
+    min_samples: int = 16       # observations before the model is trusted
+    min_probes: int = 1         # never shrink n_probes below this
+
+    def __post_init__(self):
+        expects(len(self.ladder) >= 2,
+                "ladder needs >= 2 rungs, got %s", self.ladder)
+        expects(float(self.ladder[0]) == 1.0,
+                "ladder rung 0 must be 1.0 (full quality), got %s",
+                self.ladder[0])
+        expects(all(0.0 < float(f) <= 1.0 for f in self.ladder),
+                "ladder fractions must be in (0, 1]: %s", self.ladder)
+        expects(all(float(a) > float(b) for a, b in
+                    zip(self.ladder, self.ladder[1:])),
+                "ladder must be strictly descending: %s", self.ladder)
+        expects(0.0 < self.queue_high <= self.queue_full <= 1.0,
+                "need 0 < queue_high <= queue_full <= 1, got %s / %s",
+                self.queue_high, self.queue_full)
+        expects(0.0 < self.latency_quantile <= 1.0,
+                "latency_quantile must be in (0, 1], got %s",
+                self.latency_quantile)
+        expects(self.min_samples >= 1, "min_samples must be >= 1")
+        expects(self.min_probes >= 1, "min_probes must be >= 1")
+
+    def probes_at(self, base: int, rung: int) -> int:
+        """The ladder's ``n_probes`` for ``rung`` given the configured
+        full depth ``base`` (floored at ``min_probes``)."""
+        return max(self.min_probes, int(base * float(self.ladder[rung])))
+
+    def quality_at(self, rung: int) -> str:
+        if rung <= 0:
+            return "full"
+        return ("brownout" if rung == len(self.ladder) - 1 else "reduced")
+
+
 class Ticket:
     """A submitted request's handle. The scheduler completes it from
     :meth:`BatchScheduler.pump`; ``result()`` returns the
@@ -112,10 +170,10 @@ class Ticket:
 
 class _Pending:
     __slots__ = ("queries", "k", "k_bucket", "deadline", "t_submit",
-                 "ticket", "span", "qwait")
+                 "ticket", "span", "qwait", "priority")
 
     def __init__(self, queries, k, k_bucket, deadline, t_submit, ticket,
-                 span=NULL_SPAN, qwait=NULL_SPAN):
+                 span=NULL_SPAN, qwait=NULL_SPAN, priority=0):
         self.queries = queries
         self.k = k
         self.k_bucket = k_bucket
@@ -124,6 +182,7 @@ class _Pending:
         self.ticket = ticket
         self.span = span          # request trace root
         self.qwait = qwait        # open queue_wait child (ends at dispatch)
+        self.priority = priority  # shed class: low sheds before high
 
     @property
     def rows(self) -> int:
@@ -149,7 +208,8 @@ class BatchScheduler:
                  stats: Optional[ServeStats] = None,
                  clock: Callable[[], float] = time.monotonic,
                  tracer: Optional[Tracer] = None,
-                 probe=None):
+                 probe=None,
+                 degrade: Optional[DegradePolicy] = None):
         expects(policy.max_batch <= grid.max_batch,
                 "policy.max_batch=%s exceeds the bucket grid's largest "
                 "query bucket %s — full batches would compile out-of-grid "
@@ -166,6 +226,11 @@ class BatchScheduler:
         # timestamps and latency stats share a timeline.
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.probe = probe
+        self.degrade = degrade
+        # The ladder rung the most recent dispatch served at (0 = full
+        # quality) — the scrape surface's brownout gauge
+        # (obs.registry.DegradeCollector) reads this.
+        self.brownout_level = 0
         self._clock = clock
         self._queue: List[_Pending] = []
         self._lock = threading.Lock()
@@ -192,7 +257,8 @@ class BatchScheduler:
 
     # -- admission ---------------------------------------------------------
     def submit(self, queries, k: int,
-               deadline: Optional[float] = None) -> Ticket:
+               deadline: Optional[float] = None,
+               priority: int = 0) -> Ticket:
         """Enqueue one request; returns its :class:`Ticket`.
 
         ``deadline`` is an ABSOLUTE time on the scheduler's clock (e.g.
@@ -201,6 +267,15 @@ class BatchScheduler:
         when ``max_queue`` requests are already pending; requests larger
         than the query-bucket grid raise at submit (chunk client-side —
         silently splitting would reorder against smaller requests).
+
+        ``priority`` is the request's shed class (higher = more
+        important).  A full queue sheds the NEWCOMER when everything
+        queued is at least as important; when a strictly
+        lower-priority request is queued, that victim is evicted (its
+        ticket fails with :class:`Overloaded`, counted as ``shed`` +
+        ``priority_evictions``) and the newcomer is admitted — low
+        sheds before high.  Uniform priorities reproduce the PR-9
+        shed-the-newcomer behavior exactly.
         """
         q = np.ascontiguousarray(np.asarray(queries, dtype=np.float32))
         expects(q.ndim == 2, "queries must be (n, dim), got %s", q.shape)
@@ -240,13 +315,36 @@ class BatchScheduler:
 
         kb = self.grid.bucket_k(k)
         qwait = root.child("queue_wait")
+        victim: Optional[_Pending] = None
         with self._lock:       # atomic bound check + append: the shed
             pending = len(self._queue)      # point stays exact under
             admitted = pending < self.policy.max_queue  # threaded submits
+            if not admitted and self._queue:
+                # Priority shed: evict the lowest class first, and
+                # within a class the youngest member (least sunk queue
+                # wait) — only when the newcomer strictly outranks it.
+                cand = min(self._queue,
+                           key=lambda r: (r.priority, -r.t_submit,
+                                          -r.ticket.seq))
+                if cand.priority < priority:
+                    victim = cand
+                    self._queue.remove(cand)
+                    admitted = True
             if admitted:
                 self._queue.append(_Pending(
                     q, k, kb if kb is not None else k, deadline, now,
-                    ticket, span=root, qwait=qwait))
+                    ticket, span=root, qwait=qwait, priority=priority))
+        if victim is not None:
+            vbucket = (self.grid.bucket_for(victim.rows, victim.k)
+                       or (victim.rows, victim.k))
+            self.stats.count(vbucket, "shed")
+            self.stats.count(vbucket, "priority_evictions")
+            victim.qwait.finish()
+            victim.span.finish(shed=True, evicted_by=ticket.seq)
+            victim.ticket._fail(Overloaded(
+                "evicted while queued: priority %s request arrived with "
+                "the queue full (max_queue=%s)"
+                % (priority, self.policy.max_queue)))
         self.stats.count(bucket, "requests")
         if not admitted:
             self.stats.count(bucket, "shed")
@@ -347,9 +445,55 @@ class BatchScheduler:
             self._unhook = None
 
     # -- dispatch ----------------------------------------------------------
+    def _pick_rung(self, batch: List[_Pending], bucket) -> tuple:
+        """The degradation-ladder decision for one batch: returns
+        ``(rung, reason, n_probes)`` — rung 0 / reason None / n_probes
+        None means serve at full quality.
+
+        Two pressure signals, worst wins: queue fill (``queue_high``
+        forces rung >= 1, ``queue_full`` the deepest rung) and deadline
+        budget — the tightest member deadline vs the bucket's observed
+        ``latency_quantile`` scaled by each rung's probe fraction
+        (latency ~ probes scanned); the shallowest rung that fits
+        serves, and when NONE fits the deepest rung serves anyway:
+        degrade before drop.
+        """
+        dp = self.degrade
+        base_np = getattr(getattr(self.searcher, "_params", None),
+                          "n_probes", None)
+        if dp is None or base_np is None:
+            return 0, None, None
+        rung, reason = 0, None
+        fill = self.pending() / self.policy.max_queue
+        if fill >= dp.queue_full:
+            rung, reason = len(dp.ladder) - 1, "queue_pressure"
+        elif fill >= dp.queue_high:
+            rung, reason = 1, "queue_pressure"
+        budgets = [r.deadline - self._clock() for r in batch
+                   if r.deadline is not None]
+        if budgets and rung < len(dp.ladder) - 1:
+            q_lat = self.stats.latency_quantile(
+                bucket, dp.latency_quantile, min_samples=dp.min_samples)
+            if q_lat is not None:
+                remaining = min(budgets)
+                fitted = next(
+                    (i for i in range(rung, len(dp.ladder))
+                     if q_lat * float(dp.ladder[i]) <= remaining),
+                    len(dp.ladder) - 1)   # nothing fits: deepest, not drop
+                if fitted > rung:
+                    rung, reason = fitted, "deadline_budget"
+        if rung == 0:
+            return 0, None, None
+        n_probes = dp.probes_at(int(base_np), rung)
+        if n_probes >= int(base_np):   # min_probes floor made the shrink
+            return 0, None, None       # a no-op: serve full, don't relabel
+        return rung, reason, n_probes
+
     def _dispatch(self, batch: List[_Pending], kb: int, rows: int) -> None:
         qb = self.grid.bucket_queries(rows) or rows
         bucket = (qb, kb)
+        rung, reason, n_probes = self._pick_rung(batch, bucket)
+        self.brownout_level = rung
         # One measurement per batch, attached to every member request's
         # tree below (child_at): queue_wait ends here, then assembly,
         # the searcher's fenced device spans, and result merge.
@@ -374,8 +518,10 @@ class BatchScheduler:
         try:
             # valid_rows: routed (placement="list") searchers must not
             # route / meter the bucket's zero-pad rows as traffic.
+            # n_probes: the ladder's rung (None = full depth) — a value
+            # from the closed, pre-warmed set (DegradePolicy docstring).
             res = self.searcher.search(padded, kb, span=bspan,
-                                       valid_rows=rows)
+                                       valid_rows=rows, n_probes=n_probes)
         except Exception as err:   # complete, never wedge the queue
             now = self._clock()
             for r in batch:
@@ -400,6 +546,10 @@ class BatchScheduler:
         self.stats.count(bucket, "batched_requests", len(batch))
         self.stats.count(bucket, "batched_rows", rows)
         self.stats.count(bucket, "padded_slots", qb - rows)
+        if rung > 0:
+            self.stats.count(bucket, "probes_shrunk")
+        quality = (self.degrade.quality_at(rung) if self.degrade is not None
+                   else "full")
         if rec:
             t_merge0 = self.tracer.now()
         row = 0
@@ -412,24 +562,33 @@ class BatchScheduler:
             out = SearchResult(res.distances[sl, :r.k].copy(),
                                res.indices[sl, :r.k].copy(),
                                res.coverage[sl].copy(),
-                               degraded=res.degraded)
+                               degraded=res.degraded,
+                               hedged=res.hedged,
+                               quality=quality,
+                               degrade_reason=reason)
             row += r.rows
-            if self.cache is not None and not res.degraded:
-                # Degraded (partial-coverage) answers are never cached:
-                # a hit after the shard recovers would replay the hole.
+            if self.cache is not None and not res.degraded and rung == 0:
+                # Degraded (partial-coverage) and reduced-probe answers
+                # are never cached: a hit after the shard recovers / the
+                # pressure lifts would replay the hole or the quality
+                # loss at full health.
                 self.cache.put(epoch, r.queries, r.k, out)
             rbucket = (self.grid.bucket_for(r.rows, r.k)
                        or (r.rows, r.k))
             if res.degraded:
                 self.stats.count(rbucket, "degraded_responses")
+            self.stats.count(rbucket, "served_%s" % quality)
             if r.deadline is not None and now > r.deadline:
                 self.stats.count(rbucket, "deadline_misses")
             self.stats.observe_latency(rbucket, now - r.t_submit)
             if self.probe is not None and not res.degraded:
                 # Shadow recall sampling (obs/recall.py): enqueue-only
                 # on this thread; the exact scan runs off the hot path
-                # in probe.run_pending(). Degraded answers are skipped —
-                # partial coverage would read as recall loss.
+                # in probe.run_pending(). Coverage-degraded answers are
+                # skipped — partial coverage would read as recall loss —
+                # but reduced-probe (full-coverage) answers ARE offered:
+                # the probe's recall-vs-exact measurement is exactly the
+                # served-quality feedback the ladder wants.
                 self.probe.offer(r.queries, r.k, out.indices, rbucket,
                                  epoch)
             r.ticket._complete(out)

@@ -63,13 +63,22 @@ class SearchResult:
     ``coverage`` is all-ones on healthy serves; under degraded serving
     it is the PR-2 per-query fraction of candidate rows actually
     searched (docs/fault_tolerance.md). ``degraded`` flags that a
-    live_mask was applied.
+    live_mask was applied.  ``hedged`` flags that the answer came from
+    a hedged re-dispatch that beat the straggling primary.  The
+    degradation-ladder fields (docs/fault_tolerance.md §ladder):
+    ``quality`` is the served-quality class ("full" — the configured
+    n_probes; "reduced" — a middle ladder rung; "brownout" — the
+    deepest rung), ``degrade_reason`` names what forced the rung
+    ("queue_pressure" / "deadline_budget"; None at full quality).
     """
 
     distances: np.ndarray   # (n_queries, k)
     indices: np.ndarray     # (n_queries, k)
     coverage: np.ndarray    # (n_queries,)
     degraded: bool = False
+    hedged: bool = False
+    quality: str = "full"
+    degrade_reason: Optional[str] = None
 
 
 class Searcher:
@@ -85,6 +94,7 @@ class Searcher:
                  search_params=None, merge_engine: str = "auto",
                  health=None, retry: Optional[RetryPolicy] = None,
                  wal=None, writable: bool = True,
+                 hedge=None, dispatch_hook=None,
                  sleep: Callable[[float], None] = time.sleep,
                  monotonic: Callable[[], float] = time.monotonic):
         expects(kind in _KINDS, "kind must be one of %s, got %r", _KINDS,
@@ -100,6 +110,10 @@ class Searcher:
                                 and kind != "brute_force"),
                 "a MutationLog records sharded IVF mutations (brute-"
                 "force rows are positional — nothing stable to replay)")
+        expects(hedge is None or health is not None,
+                "hedging needs a ShardHealth (the hedge re-routes "
+                "around SUSPECT shards; without health there is no "
+                "suspicion to act on)")
         self.kind = kind
         self.mesh = mesh
         self.merge_engine = merge_engine
@@ -107,6 +121,22 @@ class Searcher:
         self.retry = retry
         self.wal = wal
         self.writable = writable
+        # ``hedge``: a serve.hedge.HedgePolicy arming hedged replica
+        # dispatch for routed (placement="list") indexes.
+        # ``dispatch_hook``: called with each routed dispatch's
+        # participating ranks AFTER the dispatch — the chaos seam
+        # (ChaosMonkey.rank_hook) that advances the injected clock for
+        # scripted stragglers, so hedging is testable deterministically.
+        self.hedge = hedge
+        self._dispatch_hook = dispatch_hook
+        from raft_tpu.serve.hedge import HedgeStats
+        from raft_tpu.serve.stats import ServeStats
+
+        self.hedge_stats = HedgeStats()
+        # Private per-dispatch-shape latency windows (the hedge budget's
+        # evidence) — separate from any scheduler-owned ServeStats,
+        # whose windows hold submit->complete times incl. queueing.
+        self._dispatch_stats = ServeStats()
         self._sleep = sleep
         self._monotonic = monotonic
         self._index = index
@@ -292,7 +322,9 @@ class Searcher:
         return engine, n_chunks
 
     def _dispatch(self, queries: np.ndarray, k: int, live,
-                  valid_rows=None):
+                  valid_rows=None, params=None, suspect=None,
+                  plan_cb=None):
+        params = params if params is not None else self._params
         if self.kind == "brute_force":
             if self.mesh is None:
                 from raft_tpu.neighbors import brute_force
@@ -307,35 +339,112 @@ class Searcher:
             if self.mesh is None:
                 from raft_tpu.neighbors import ivf_flat
 
-                return ivf_flat.search(self._params, self._index, queries, k)
+                return ivf_flat.search(params, self._index, queries, k)
             from raft_tpu.parallel.ivf import sharded_ivf_flat_search
 
-            return sharded_ivf_flat_search(self.mesh, self._params,
+            return sharded_ivf_flat_search(self.mesh, params,
                                            self._index, queries, k,
                                            merge_engine=self.merge_engine,
                                            live_mask=live,
-                                           valid_rows=valid_rows)
+                                           valid_rows=valid_rows,
+                                           suspect_mask=suspect,
+                                           plan_cb=plan_cb)
         if self.mesh is None:
             from raft_tpu.neighbors import ivf_pq
 
-            return ivf_pq.search(self._params, self._index, queries, k)
+            return ivf_pq.search(params, self._index, queries, k)
         from raft_tpu.parallel.ivf import sharded_ivf_pq_search
 
-        return sharded_ivf_pq_search(self.mesh, self._params, self._index,
+        return sharded_ivf_pq_search(self.mesh, params, self._index,
                                      queries, k,
                                      merge_engine=self.merge_engine,
                                      live_mask=live,
-                                     valid_rows=valid_rows)
+                                     valid_rows=valid_rows,
+                                     suspect_mask=suspect,
+                                     plan_cb=plan_cb)
+
+    def _is_routed(self) -> bool:
+        return (self.mesh is not None
+                and getattr(self._index, "placement", "row") == "list")
+
+    def _after_dispatch(self, plan, t0: float):
+        """Post-dispatch health plumbing for one routed dispatch: run
+        the chaos/dispatch hook with the plan's participants (scripted
+        stragglers advance the injected clock HERE — deterministically),
+        then attribute the elapsed time to every participant
+        (``ShardHealth.observe_latency`` — the SUSPECT feed).  Returns
+        ``(participant ranks, elapsed seconds)``."""
+        from raft_tpu.parallel.routing import participant_ranks
+
+        ranks = participant_ranks(plan)
+        if self._dispatch_hook is not None:
+            self._dispatch_hook(ranks)
+        elapsed = self._monotonic() - t0
+        if self.health is not None:
+            for r in ranks:
+                self.health.observe_latency(int(r), elapsed)
+        return ranks, elapsed
+
+    def _maybe_hedge(self, out, q, k: int, live, params, valid_rows,
+                     suspect, ranks, elapsed: float):
+        """The hedge decision for one completed routed dispatch: when
+        the elapsed time outlived the per-bucket budget AND a
+        participant has (newly) gone suspect, re-dispatch with the
+        fresh suspect mask — every replicated list steers onto the
+        healthy copy — and serve the faster-by-the-clock answer.
+        Returns ``(result, hedged, elapsed_of_served)``."""
+        bucket = (int(q.shape[0]), int(k))
+        budget = self.hedge.budget(self._dispatch_stats.latency_quantile(
+            bucket, self.hedge.quantile,
+            min_samples=self.hedge.min_samples))
+        if budget is None or elapsed <= budget:
+            return out, False, elapsed
+        prev = suspect if suspect is not None else np.zeros(
+            self.health.n_ranks, bool)
+        now = self.health.suspect_mask
+        if not any(now[int(r)] and not prev[int(r)] for r in ranks):
+            # Over budget but re-planning would repeat the same route
+            # (no NEW suspect participant to steer around).
+            self.hedge_stats.record(suppressed=True)
+            return out, False, elapsed
+        self.hedge_stats.record(fired=True)
+        plan_box: list = []
+        t1 = self._monotonic()
+        out2 = self._dispatch(q, k, live, valid_rows=valid_rows,
+                              params=params, suspect=now,
+                              plan_cb=plan_box.append)
+        elapsed2 = elapsed
+        if plan_box:
+            _, elapsed2 = self._after_dispatch(plan_box[-1], t1)
+        if elapsed2 < elapsed:
+            self.hedge_stats.record(won=True)
+            return out2, True, elapsed2
+        return out, True, elapsed
 
     def search(self, queries, k: int,
                degraded: Optional[bool] = None,
-               span=None, valid_rows: Optional[int] = None
+               span=None, valid_rows: Optional[int] = None,
+               n_probes: Optional[int] = None
                ) -> SearchResult:
         """One synchronous search, already shaped (the scheduler owns
         bucketing/padding). ``degraded=None`` auto-selects: the healthy
         trace while every shard is live, the live_mask trace (exact over
         survivors + coverage) as soon as the health registry reports a
         dead rank. Retries under ``self.retry`` when set.
+
+        ``n_probes`` overrides the configured probe count for THIS
+        call (IVF kinds) — the degradation ladder's knob
+        (serve/scheduler.DegradePolicy).  n_probes is a jit STATIC:
+        only ladder-rung values pre-compiled by
+        ``serve.bucketing.warmup(degrade_ladder=...)`` stay
+        recompile-free in steady state.
+
+        Routed (placement="list") searchers with a ShardHealth route
+        around SUSPECT shards (plan_route suspect preference), feed
+        per-shard dispatch latencies back into the health registry, and
+        — with a :class:`~raft_tpu.serve.hedge.HedgePolicy` — hedge a
+        dispatch that outlives its per-bucket budget to the replicas,
+        first result by the injected clock wins (``SearchResult.hedged``).
 
         ``span`` (an :class:`raft_tpu.obs.trace.Span`) attaches the two
         device-boundary child spans — ``device_dispatch`` (fenced with
@@ -355,20 +464,48 @@ class Searcher:
                 q.shape[1], self.dim)
         expects(k >= 1, "k must be >= 1, got %s", k)
         live = self._resolve_live(degraded)
+        params = self._params
+        if n_probes is not None and self.kind != "brute_force":
+            import dataclasses
+
+            params = dataclasses.replace(self._params,
+                                         n_probes=int(n_probes))
+        routed = self._is_routed()
+        suspect = None
+        if routed and self.health is not None:
+            sus = self.health.suspect_mask
+            if sus.any():
+                suspect = sus
+        track = routed and (self.health is not None
+                            or self._dispatch_hook is not None)
+        plan_box: list = []
 
         def attempt():
-            return self._dispatch(q, k, live, valid_rows=valid_rows)
+            return self._dispatch(q, k, live, valid_rows=valid_rows,
+                                  params=params, suspect=suspect,
+                                  plan_cb=plan_box.append if track
+                                  else None)
 
         import jax
 
+        hedged = False
         with sp.child("device_dispatch", kind=self.kind,
                       engine=self.merge_engine,
                       sharded=self.mesh is not None) as dd:
+            t0 = self._monotonic()
             if self.retry is not None:
                 out = with_retry(attempt, self.retry, sleep=self._sleep,
                                  monotonic=self._monotonic)
             else:
                 out = attempt()
+            if track and plan_box:
+                ranks, elapsed = self._after_dispatch(plan_box[-1], t0)
+                if self.hedge is not None and self.health is not None:
+                    out, hedged, elapsed = self._maybe_hedge(
+                        out, q, k, live, params, valid_rows, suspect,
+                        ranks, elapsed)
+                self._dispatch_stats.observe_latency(
+                    (int(q.shape[0]), int(k)), elapsed)
             if dd.recording:
                 # Fence so the span closes when the DEVICE finishes, not
                 # when XLA accepted the async dispatch — device time is
@@ -404,9 +541,57 @@ class Searcher:
             host = jax.device_get(out)
         if len(host) == 3:
             d, i, cov = host
-            return SearchResult(d, i, cov, degraded=True)
+            return SearchResult(d, i, cov, degraded=True, hedged=hedged)
         d, i = host
-        return SearchResult(d, i, np.ones(q.shape[0], np.float32))
+        return SearchResult(d, i, np.ones(q.shape[0], np.float32),
+                            hedged=hedged)
+
+    def shadow_probe(self, rank: int, queries, k: int) -> float:
+        """One off-the-hot-path probe of a dead/suspect shard: dispatch
+        the warmed DEGRADED trace with ``rank`` forced live in the mask
+        (the mask is a traced operand — one trace covers every value,
+        so probing compiles nothing and moves nothing implicitly) under
+        suppressed telemetry (shadow traffic must not skew the serving
+        scrapes or the placement balancer's loads).  Returns the
+        injected-clock elapsed seconds; raises whatever the dispatch
+        raises — the :class:`~raft_tpu.serve.recovery.RecoveryProber`
+        turns (elapsed, exception) into its clean/dirty verdict.
+        Probe latencies deliberately do NOT feed
+        ``health.observe_latency``: the candidate's slowness is the
+        prober's verdict to make, not new fleet-wide evidence."""
+        expects(self.health is not None and self.mesh is not None,
+                "shadow_probe needs a sharded searcher with ShardHealth")
+        from raft_tpu.comms.topk_merge import merge_dispatch_stats
+        from raft_tpu.parallel.routing import routing_stats
+
+        q = np.asarray(queries)
+        expects(q.ndim == 2 and q.shape[1] == self.dim,
+                "probe queries must be (n, %s), got %s", self.dim,
+                q.shape)
+        live = self.health.live_mask
+        live[int(rank)] = True
+        plan_box: list = []
+        track = self._is_routed()
+        import jax
+
+        t0 = self._monotonic()
+        with merge_dispatch_stats.suppress(), routing_stats.suppress():
+            out = self._dispatch(q, k, live,
+                                 plan_cb=plan_box.append if track
+                                 else None)
+            jax.block_until_ready(out)
+        if self._dispatch_hook is not None:
+            from raft_tpu.parallel.routing import participant_ranks
+
+            ranks = (participant_ranks(plan_box[-1]) if plan_box
+                     else np.arange(self.health.n_ranks))
+            # The probed rank always counts as a participant: a chaos
+            # delay scripted against it must slow the probe even when
+            # the plan happened to route every query elsewhere —
+            # otherwise a vacuous probe would read clean and re-admit
+            # a still-faulty shard.
+            self._dispatch_hook(np.union1d(ranks, [int(rank)]))
+        return self._monotonic() - t0
 
     # -- lifecycle ---------------------------------------------------------
     def extend(self, new_vectors, new_indices=None) -> None:

@@ -36,7 +36,14 @@ LATENCY_WINDOW = 4096
 _COUNTERS = ("requests", "queued", "batches", "batched_requests",
              "padded_slots", "batched_rows", "cache_hits", "cache_misses",
              "shed", "deadline_misses", "degraded_responses", "failed",
-             "out_of_grid")
+             "out_of_grid",
+             # Degradation-ladder quality classes (docs/fault_tolerance.md
+             # §ladder): every completed request lands in exactly one.
+             "served_full", "served_reduced", "served_brownout",
+             # Answers whose n_probes was shrunk by the ladder; queued
+             # low-priority requests evicted for a higher-priority
+             # arrival (evictions also count toward "shed").
+             "probes_shrunk", "priority_evictions")
 
 
 class ServeStats:
@@ -83,6 +90,24 @@ class ServeStats:
     def record_compile(self, n: int = 1) -> None:
         with self._lock:
             self.compile_events += n
+
+    def latency_quantile(self, bucket: BucketKey, q: float,
+                         min_samples: int = 1) -> Optional[float]:
+        """Windowed nearest-rank latency quantile for one bucket, or
+        ``None`` before ``min_samples`` observations landed — the
+        per-bucket latency model the hedge budget and the degradation
+        ladder consume (both must refuse to act on thin evidence)."""
+        with self._lock:
+            lat = self._latency.get(bucket)
+            if lat is None or len(lat) < max(1, min_samples):
+                return None
+            return float(self._quantile(list(lat), q))
+
+    def latency_samples(self, bucket: BucketKey) -> int:
+        """Live sample-window size for one bucket."""
+        with self._lock:
+            lat = self._latency.get(bucket)
+            return 0 if lat is None else len(lat)
 
     @staticmethod
     def _quantile(samples, q: float) -> float:

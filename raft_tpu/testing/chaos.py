@@ -9,7 +9,7 @@ the repo: wrap an eager call site, script faults at exact call indexes,
 and the failure sequence replays bit-for-bit on every run — no
 wall-clock, no unseeded randomness.
 
-Five fault kinds (the failure modes of the sharded serving story):
+Six fault kinds (the failure modes of the sharded serving story):
 
 * ``"raise"``   — the call site raises :class:`InjectedFault` (or a
   caller-supplied exception factory) — a lost transfer / IO error.
@@ -27,6 +27,14 @@ Five fault kinds (the failure modes of the sharded serving story):
   raises WITHOUT renaming, leaving the ``.tmp`` file orphaned — a kill
   between a multi-file save's renames (some files published, some not;
   the torn-snapshot state the manifest check must catch).
+* ``"delay"`` — the call runs, but only after ``seconds`` of injected
+  sleep (``ChaosMonkey(sleep=...)`` — a test's fake clock, so the
+  straggler is deterministic and replayable) — the SLOW shard, the
+  dominant production failure mode the hedging/SUSPECT machinery
+  exists for.  ``at=None`` scripts the fault at EVERY call (a
+  persistent straggler rather than a one-shot hiccup), and
+  :meth:`ChaosMonkey.rank_hook` scopes the delay to dispatches a
+  scripted victim rank actually participates in.
 
 Usage::
 
@@ -60,30 +68,38 @@ class FaultSpec:
     """One scripted fault: apply ``kind`` at the given 0-based call
     indexes of a wrapped site.
 
-    ``rank`` names the victim for ``"drop_rank"``; ``error`` overrides
-    the raised exception factory for ``"raise"`` (a callable returning
-    an exception instance, so each attempt gets a fresh object and
-    retry cause-chains stay acyclic); ``offset`` is the byte offset a
+    ``at=None`` means every call index (a persistent fault — the shape
+    a straggling shard takes); ``rank`` names the victim for
+    ``"drop_rank"`` and the participation scope for ``"delay"`` under
+    :meth:`ChaosMonkey.rank_hook`; ``error`` overrides the raised
+    exception factory for ``"raise"`` (a callable returning an
+    exception instance, so each attempt gets a fresh object and retry
+    cause-chains stay acyclic); ``offset`` is the byte offset a
     ``"torn_write"`` truncates the payload at (clamped to the payload
-    length; 0 = nothing written before the tear).
+    length; 0 = nothing written before the tear); ``seconds`` is the
+    injected-clock sleep of a ``"delay"``.
     """
 
     kind: str = "raise"   # "raise" | "corrupt" | "drop_rank"
-    #                     # | "torn_write" | "partial_rename"
-    at: Tuple[int, ...] = (0,)
+    #                     # | "torn_write" | "partial_rename" | "delay"
+    at: Optional[Tuple[int, ...]] = (0,)
     rank: int = -1
     error: Optional[Callable[[], BaseException]] = None
     offset: int = -1
+    seconds: float = 0.0
 
     def __post_init__(self):
         expects(self.kind in ("raise", "corrupt", "drop_rank",
-                              "torn_write", "partial_rename"),
+                              "torn_write", "partial_rename", "delay"),
                 "unknown fault kind %r", self.kind)
         if self.kind == "drop_rank":
             expects(self.rank >= 0, "drop_rank needs a victim rank")
         if self.kind == "torn_write":
             expects(self.offset >= 0,
                     "torn_write needs the byte offset to tear at")
+        if self.kind == "delay":
+            expects(self.seconds > 0.0,
+                    "delay needs seconds > 0, got %s", self.seconds)
 
 
 @dataclass
@@ -102,12 +118,16 @@ class ChaosMonkey:
     corrupted payloads every run.
     """
 
-    def __init__(self, seed: int = 0, health=None):
+    def __init__(self, seed: int = 0, health=None, sleep=None):
         # ``health``: an optional raft_tpu.comms.health.ShardHealth that
         # "drop_rank" faults feed (kept untyped to avoid a hard import).
+        # ``sleep``: the clock-advancing callable "delay" faults consume
+        # (a test's fake clock's ``sleep`` — never wall time, or the
+        # replayed schedule stops being bit-identical).
         self.seed = seed
         self.rng = np.random.default_rng(seed)
         self.health = health
+        self.sleep = sleep
         self._sites: Dict[str, _Site] = {}
 
     # -- scripting --------------------------------------------------------
@@ -145,6 +165,8 @@ class ChaosMonkey:
                 raise (fault.error() if fault.error is not None
                        else InjectedFault(
                            f"injected fault at {site}[{idx}]"))
+            if fault is not None and fault.kind == "delay":
+                self._sleep(fault, site, idx)   # straggle, then proceed
             out = fn(*args, **kwargs)
             if fault is not None and fault.kind == "corrupt":
                 out = self.corrupt(out)
@@ -243,7 +265,50 @@ class ChaosMonkey:
                 raise (fault.error() if fault.error is not None
                        else InjectedFault(
                            f"injected fault at {site}[{idx}]"))
+            elif fault.kind == "delay":
+                self._sleep(fault, site, idx)
         return idx
+
+    def rank_hook(self, site: str) -> Callable:
+        """A ``hook(ranks)`` callable for rank-scoped sites: the Searcher
+        calls it after each dispatch with the participating ranks, and a
+        scripted ``"delay"`` fault sleeps ONLY when its victim ``rank``
+        is among them (``rank < 0`` = any participant) — so a straggling
+        shard slows exactly the dispatches that touch it, and queries
+        routed around it (replica preference) dodge the delay.
+        ``"drop_rank"`` faults fire regardless of participation (the
+        host dies whether or not this dispatch used it).  The site
+        counter counts every invocation; returns the consumed index."""
+        state = self._sites.setdefault(site, _Site())
+
+        def on_ranks(ranks) -> int:
+            idx = state.calls
+            state.calls += 1
+            fault = self._fault_at(state, idx)
+            if fault is None:
+                return idx
+            if fault.kind == "drop_rank":
+                expects(self.health is not None,
+                        "drop_rank fault needs ChaosMonkey(health=...)")
+                self.health.mark_dead(fault.rank)
+            elif fault.kind == "delay":
+                participants = {int(r) for r in np.asarray(ranks).reshape(-1)}
+                if fault.rank < 0 or fault.rank in participants:
+                    self._sleep(fault, site, idx)
+            elif fault.kind == "raise":
+                raise (fault.error() if fault.error is not None
+                       else InjectedFault(
+                           f"injected fault at {site}[{idx}]"))
+            return idx
+
+        return on_ranks
+
+    def _sleep(self, fault: FaultSpec, site: str, idx: int) -> None:
+        expects(self.sleep is not None,
+                "delay fault at %s[%s] needs ChaosMonkey(sleep=...) — "
+                "inject the test clock's sleep, never wall time",
+                site, idx)
+        self.sleep(fault.seconds)
 
     # -- payload corruption ----------------------------------------------
     def corrupt(self, payload):
@@ -284,6 +349,12 @@ class ChaosMonkey:
         s = self._sites.get(site)
         return 0 if s is None else s.calls
 
+    def clear(self, site: str) -> None:
+        """Drop every scripted fault at ``site`` (the call counter keeps
+        counting) — how a scenario models a fault that ENDED: the
+        straggler recovered, so later probes/dispatches run clean."""
+        self._sites.setdefault(site, _Site()).faults.clear()
+
     def reset(self, site: Optional[str] = None) -> None:
         """Reset call counters (and the corruption RNG stream) so a
         scripted scenario replays from the top."""
@@ -297,6 +368,6 @@ class ChaosMonkey:
     @staticmethod
     def _fault_at(state: _Site, idx: int) -> Optional[FaultSpec]:
         for f in state.faults:
-            if idx in f.at:
+            if f.at is None or idx in f.at:
                 return f
         return None

@@ -679,3 +679,57 @@ def test_sentinel_values():
     assert float(sentinels.dummy_key_val(np.float32, True)) == float("inf")
     assert int(sentinels.dummy_key_val(np.int32, False)) == \
         np.iinfo(np.int32).min
+
+
+# ---------------------------------------------------------------------------
+# wall-clock: serve/ and lifecycle/ read the injected clock
+
+
+def test_wall_clock_flags_direct_calls_in_scope():
+    src = ('"""Doc."""\n'
+           "import time\n"
+           "def tick():\n"
+           "    return time.monotonic()\n")
+    assert lines_of(run({"raft_tpu/serve/mod.py": src}, ["wall-clock"]),
+                    "wall-clock") == [4]
+    assert lines_of(run({"raft_tpu/lifecycle/mod.py": src},
+                        ["wall-clock"]), "wall-clock") == [4]
+
+
+def test_wall_clock_resolves_from_import_and_alias():
+    src = ('"""Doc."""\n'
+           "from time import monotonic\n"
+           "def tick():\n"
+           "    return monotonic()\n")
+    assert lines_of(run({"raft_tpu/serve/mod.py": src}, ["wall-clock"]),
+                    "wall-clock") == [4]
+    src = ('"""Doc."""\n'
+           "import time as t\n"
+           "def nap():\n"
+           "    t.sleep(1.0)\n")
+    assert lines_of(run({"raft_tpu/serve/mod.py": src}, ["wall-clock"]),
+                    "wall-clock") == [4]
+
+
+def test_wall_clock_default_arg_reference_is_legal():
+    """``monotonic=time.monotonic`` as a ctor default IS the injection
+    point — only Call nodes flag, never bare references."""
+    src = ('"""Doc."""\n'
+           "import time\n"
+           "def serve(clock=time.monotonic, sleep=time.sleep):\n"
+           "    return clock()\n")
+    assert run({"raft_tpu/serve/mod.py": src}, ["wall-clock"]) == []
+
+
+def test_wall_clock_scope_and_waiver():
+    src = ('"""Doc."""\n'
+           "import time\n"
+           "def tick():\n"
+           "    return time.time()\n")
+    # Out of scope: kernels/benches may time real device work.
+    assert run({"raft_tpu/neighbors/mod.py": src}, ["wall-clock"]) == []
+    waived = ('"""Doc."""\n'
+              "import time\n"
+              "def tick():\n"
+              "    return time.time()  # analyze: wall-clock-ok (why)\n")
+    assert run({"raft_tpu/serve/mod.py": waived}, ["wall-clock"]) == []

@@ -341,3 +341,75 @@ def test_resize_cutover_steady_state(mesh4, sanitizer_lane):
         res = s.search(q, K)
         assert res.indices.shape == (8, K)
     assert sanitizer_lane.steady_compiles == 0
+
+
+# ---------------------------------------------------------------------------
+# Elastic x health: resizes respect the liveness/suspicion registry
+# (ISSUE 19 satellite)
+
+
+class TestElasticHealthGate:
+    def test_join_of_degraded_rank_raises_until_mark_live(self, mesh4):
+        """No-silent-revive: a resize must not pull a dead or suspect
+        shard back into the serving set — re-admission is mark_live's
+        explicit edge (the RecoveryProber path)."""
+        from raft_tpu.comms import ShardHealth
+        from raft_tpu.comms.health import LatencyPolicy
+
+        health = ShardHealth(N_DEV, latency=LatencyPolicy())
+        s, sp = _searcher(mesh4, health=health)
+        leave_shard(s, 2)
+        health.mark_dead(2)
+        with pytest.raises(LogicError, match="mark_live"):
+            join_shard(s, 2)
+        health.mark_live(2)
+        health.mark_suspect(2)                 # straggler, not corpse
+        with pytest.raises(LogicError, match="mark_live"):
+            join_shard(s, 2)
+        health.mark_live(2)
+        rep = join_shard(s, 2)                 # re-admitted: join works
+        assert 2 in rep.active_after
+        assert serving_shards(s._index) == (0, 1, 2, 3)
+
+    def test_resize_places_replicas_off_suspect_members(self, mesh4):
+        """A leave's replica re-placement avoids SUSPECT ranks too: the
+        fault-tolerance copy must not land exactly where hedges are
+        already routing away from."""
+        from raft_tpu.comms import ShardHealth
+        from raft_tpu.comms.health import LatencyPolicy
+
+        health = ShardHealth(N_DEV, latency=LatencyPolicy())
+        s, sp = _searcher(mesh4, replicate=(0, 1), health=health)
+        health.mark_suspect(2)
+        leave_shard(s, 3)
+        pm = s._index.placement_map
+        for lid in (0, 1):
+            rep = int(pm.replica_owner[lid])
+            assert rep >= 0                    # still replicated
+            assert rep != int(pm.owner[lid])
+            assert rep not in (2, 3)           # off suspect AND leaver
+        # serving still exact vs an undisturbed reference
+        q = _db(11, n=16)
+        ref, _ = _build(mesh4, replicate=(0, 1))
+        d0, i0 = _results(mesh4, sp, ref, q)
+        d1, i1 = _results(mesh4, sp, s._index, q)
+        np.testing.assert_array_equal(i1, i0)
+
+    def test_all_degraded_fallback_keeps_old_placement_rules(self, mesh4):
+        """Degenerate case: every candidate rank suspect — the resize
+        falls back to the pre-health placement behavior (excluding only
+        a leaver) instead of dropping the replicas."""
+        from raft_tpu.comms import ShardHealth
+        from raft_tpu.comms.health import LatencyPolicy
+
+        health = ShardHealth(N_DEV, latency=LatencyPolicy())
+        s, sp = _searcher(mesh4, replicate=(0, 1), health=health)
+        for r in range(N_DEV):
+            if r != 3:
+                health.mark_suspect(r)
+        leave_shard(s, 3)
+        pm = s._index.placement_map
+        for lid in (0, 1):
+            rep = int(pm.replica_owner[lid])
+            assert rep >= 0 and rep != 3       # replicated, off the leaver
+            assert rep != int(pm.owner[lid])

@@ -576,3 +576,465 @@ class TestRetriedCallSites:
                         retry=RetryPolicy(max_attempts=2, base_delay=0.0,
                                           retry_on=(OSError,)))
         assert chaos.calls("savez") == 2
+
+
+# ---------------------------------------------------------------------------
+# Latency-aware health: SUSPECT promotion + the full transition grid
+# (ISSUE 19 tentpole)
+
+
+def _lat_policy(**kw):
+    from raft_tpu.comms import LatencyPolicy
+
+    return LatencyPolicy(**{"alpha": 0.25, "window": 8, "quantile": 0.9,
+                            "multiplier": 3.0, "min_samples": 4, **kw})
+
+
+class TestLatencyHealth:
+    def test_policy_validation(self):
+        from raft_tpu.comms import LatencyPolicy
+
+        with pytest.raises(LogicError):
+            LatencyPolicy(alpha=0.0)
+        with pytest.raises(LogicError):
+            LatencyPolicy(multiplier=1.0)
+        with pytest.raises(LogicError):
+            LatencyPolicy(quantile=1.5)
+        with pytest.raises(LogicError):
+            LatencyPolicy(min_samples=0)
+        with pytest.raises(LogicError):
+            LatencyPolicy(window=0)
+
+    def test_sustained_straggler_promoted_but_stays_live(self):
+        h = ShardHealth(4, latency=_lat_policy())
+        for _ in range(4):
+            for r in range(4):
+                h.observe_latency(r, 0.001)
+        assert h.n_suspect() == 0
+        # One 11x dispatch: EWMA 3.5x fleet median AND the windowed q0.9
+        # cross the 3x threshold together -> suspect.
+        assert h.observe_latency(1, 0.011)
+        assert h.state(1) == "suspect"
+        assert h.is_suspect(1) and h.is_live(1)   # sub-state of live
+        np.testing.assert_array_equal(h.live_mask, np.ones(4, bool))
+        np.testing.assert_array_equal(h.suspect_mask,
+                                      [False, True, False, False])
+        assert h.n_suspect() == 1 and h.n_live() == 4
+        # the masks are copies, not views of registry state
+        m = h.suspect_mask
+        m[0] = True
+        assert not h.is_suspect(0)
+
+    def test_single_spike_filtered_by_quantile_gate(self):
+        """One outlier sample moves the EWMA but not the windowed
+        quantile — the two-signal AND keeps a hiccup from convicting."""
+        h = ShardHealth(4, latency=_lat_policy(window=16, min_samples=8))
+        for _ in range(15):
+            for r in range(4):
+                h.observe_latency(r, 0.001)
+        assert not h.observe_latency(1, 1.0)
+        assert not h.is_suspect(1)
+
+    def test_min_samples_and_fleet_median_gates(self):
+        h = ShardHealth(4, latency=_lat_policy())
+        for _ in range(3):                 # < min_samples: never suspect
+            assert not h.observe_latency(1, 9.9)
+        assert not h.is_suspect(1)
+        # a single observed rank has no fleet to be slower than
+        h2 = ShardHealth(4, latency=_lat_policy())
+        for _ in range(8):
+            assert not h2.observe_latency(0, 5.0)
+        assert not h2.is_suspect(0)
+
+    def test_only_mark_live_clears_suspicion_and_resets_history(self):
+        h = ShardHealth(4, latency=_lat_policy())
+        for _ in range(4):
+            for r in range(4):
+                h.observe_latency(r, 0.001)
+        for _ in range(4):
+            h.observe_latency(1, 0.02)
+        assert h.is_suspect(1)
+        # healthy observations do NOT auto-clear an existing conviction
+        for _ in range(8):
+            assert h.observe_latency(1, 0.001)
+        assert h.is_suspect(1)
+        h.mark_live(1)
+        assert h.state(1) == "live"
+        # latency history reset: the convicting samples describe the
+        # fault, not the recovered shard — no instant re-suspect
+        assert np.isnan(h.latency_ewma(1))
+        assert not h.observe_latency(1, 0.001)
+        assert not h.is_suspect(1)
+
+    def test_dead_overrides_suspect(self):
+        h = ShardHealth(2, latency=_lat_policy())
+        h.mark_suspect(0)
+        h.mark_dead(0)
+        assert h.state(0) == "dead"
+        assert not h.is_suspect(0)
+        assert not h.observe_latency(0, 5.0)   # dead ranks are ignored
+        h.mark_suspect(0)                      # no-op for a dead rank
+        assert h.state(0) == "dead"
+
+    def test_transition_grid_watch_vs_listener_channels(self):
+        """Satellite: every edge of the three-state machine, seen by the
+        right channels — ``watch`` per-rank callbacks and the state
+        listener fire on all edges; the binary listener stays silent on
+        suspect edges (a promotion watcher must not fail over for a
+        slow-but-correct shard)."""
+        h = ShardHealth(3, latency=_lat_policy())
+        edges, binary, states = [], [], []
+        h.watch(1, on_dead=lambda: edges.append("dead"),
+                on_live=lambda: edges.append("live"),
+                on_suspect=lambda: edges.append("suspect"))
+        h.add_listener(lambda r, live: binary.append((r, live)))
+        h.add_state_listener(lambda r, s: states.append((r, s)))
+        h.mark_suspect(1)          # live -> suspect
+        h.mark_suspect(1)          # idempotent: no re-fire
+        h.mark_live(1)             # suspect -> live (binary silent)
+        h.mark_live(1)             # idempotent
+        h.mark_dead(1)             # live -> dead
+        h.mark_dead(1)             # idempotent
+        h.mark_live(1)             # dead -> live (binary fires)
+        h.mark_suspect(2)          # other rank: watch(1) must not fire
+        assert edges == ["suspect", "live", "dead", "live"]
+        assert binary == [(1, False), (1, True)]
+        assert states == [(1, "suspect"), (1, "live"), (1, "dead"),
+                          (1, "live"), (2, "suspect")]
+
+    def test_watch_unsubscribe_idempotent_and_validation(self):
+        h = ShardHealth(2)
+        seen = []
+        unsub = h.watch(0, on_dead=lambda: seen.append("d"))
+        unsub()
+        unsub()                      # idempotent
+        h.mark_dead(0)
+        assert seen == []
+        with pytest.raises(LogicError):
+            h.watch(0)               # no callbacks at all
+        with pytest.raises(LogicError):
+            h.watch(9, on_dead=lambda: None)
+
+
+# ---------------------------------------------------------------------------
+# Hedged replica dispatch under a scripted straggler (ISSUE 19 tentpole)
+
+#: Simulated per-dispatch service time on the injected clock.
+SERVICE = 0.001
+
+
+@pytest.fixture(scope="module")
+def straggler_setup(mesh4):
+    """Routed (placement='list') index with every list of the victim
+    rank replicated — the bench/degrade.py straggler scenario shape."""
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                   sharded_replicate_lists)
+
+    rng = np.random.default_rng(91)
+    n, d, n_lists = 2048, 16, 16
+    cc = rng.normal(size=(n_lists, d)).astype(np.float32) * 4
+    db = (cc[rng.integers(0, n_lists, size=n)]
+          + rng.normal(size=(n, d)).astype(np.float32))
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4)
+    base = sharded_ivf_flat_build(mesh4, params, db, placement="list")
+    victim = 1
+    pm = base.placement_map
+    index = sharded_replicate_lists(mesh4, base,
+                                    np.flatnonzero(pm.owner == victim))
+    centers = np.asarray(jax.device_get(index.centers))
+    rank_lists = [np.flatnonzero(pm.owner == r) for r in range(4)]
+    return dict(index=index, victim=victim, centers=centers,
+                rank_lists=rank_lists, d=d)
+
+
+def _rank_queries(setup, rng, rank, j=0, m=8):
+    """m queries at the center of ONE list ``rank`` owns: with
+    n_probes=1 the dispatch's participant set is exactly that rank
+    (replica read balancing is whole-list), so per-shard latency
+    attribution is exact."""
+    lists = setup["rank_lists"][rank]
+    pick = np.full(m, lists[j % len(lists)])
+    return (setup["centers"][pick]
+            + 0.01 * rng.normal(size=(m, setup["d"])).astype(np.float32))
+
+
+def _straggler_serving(setup, mesh4, hedged):
+    from raft_tpu.comms import LatencyPolicy, ShardHealth as _SH
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.serve import HedgePolicy, Searcher
+
+    clock = FakeClock()
+    monkey = ChaosMonkey(seed=19, sleep=clock.sleep)
+    rank_hook = monkey.rank_hook("serve.dispatch")
+
+    def hook(ranks):
+        clock.sleep(SERVICE)       # every dispatch costs SERVICE …
+        rank_hook(ranks)           # … plus the scripted straggler delay
+
+    kw = dict(mesh=mesh4, dispatch_hook=hook, monotonic=clock.monotonic)
+    if hedged:
+        kw["health"] = _SH(4, latency=LatencyPolicy(
+            alpha=0.25, window=8, quantile=0.9, multiplier=3.0,
+            min_samples=4))
+        kw["hedge"] = HedgePolicy(quantile=0.9, multiplier=2.0,
+                                  min_samples=4)
+    s = Searcher.ivf_flat(setup["index"],
+                          ivf_flat.SearchParams(n_probes=1), **kw)
+    return s, kw.get("health"), clock, monkey
+
+
+class TestHedgedStragglerServing:
+    N_WARM = 16       # 4 cycles x 4 ranks: every rank's EWMA converged
+    N_REQ = 120       # p99 index 118: one outlier cannot own the p99
+    K = 10
+
+    def _stream(self, setup, mesh4, hedged):
+        s, health, clock, monkey = _straggler_serving(setup, mesh4, hedged)
+        rng = np.random.default_rng(17)
+        for i in range(self.N_WARM):
+            s.search(_rank_queries(setup, rng, i % 4, i // 4), self.K)
+        monkey.script("serve.dispatch", [FaultSpec(
+            kind="delay", at=None, rank=setup["victim"],
+            seconds=10 * SERVICE)])
+        lats, cov_min = [], 1.0
+        for i in range(self.N_REQ):
+            t0 = clock.monotonic()
+            out = s.search(_rank_queries(setup, rng, i % 4, i // 4),
+                           self.K)
+            lats.append(clock.monotonic() - t0)
+            cov_min = min(cov_min, float(out.coverage.min()))
+        return s, health, np.asarray(lats), cov_min
+
+    @staticmethod
+    def _p99(lats):
+        s = np.sort(lats)
+        return float(s[int(np.ceil(0.99 * len(s))) - 1])
+
+    def test_unhedged_p99_tracks_the_straggler(self, straggler_setup,
+                                               mesh4):
+        _, _, lats, cov_min = self._stream(straggler_setup, mesh4,
+                                           hedged=False)
+        # no defense: every victim-targeted request pays the full delay
+        assert self._p99(lats) >= 10 * SERVICE
+        assert cov_min == 1.0            # slow, but no coverage loss
+
+    def test_hedged_holds_coverage_and_p99(self, straggler_setup, mesh4):
+        """Acceptance: under the same scripted straggler, hedged serving
+        keeps coverage 1.0 and p99 at the healthy baseline (SERVICE) —
+        the victim is convicted on its FIRST slow dispatch, the hedge
+        wins through the replica, and every later victim-targeted
+        request routes around the suspect proactively."""
+        victim = straggler_setup["victim"]
+        s, health, lats, cov_min = self._stream(straggler_setup, mesh4,
+                                                hedged=True)
+        assert cov_min == 1.0
+        assert health.is_suspect(victim) and health.is_live(victim)
+        assert health.n_suspect() == 1
+        snap = s.hedge_stats.snapshot()
+        assert snap["fired"] >= 1 and snap["won"] >= 1
+        # p99 within 2x the healthy baseline (vs 11x unhedged)
+        assert self._p99(lats) <= 2 * SERVICE
+        # exactly ONE request paid the straggler: the conviction request
+        # (primary delay + winning hedge re-dispatch)
+        slow = lats > 2 * SERVICE
+        assert slow.sum() == 1
+        assert lats[slow][0] == pytest.approx(12 * SERVICE)
+        # post-conviction victim-targeted requests dodge the delay via
+        # replica preference (plan_route suspect_mask)
+        on_victim = (np.arange(self.N_REQ) % 4) == victim
+        assert np.all(lats[on_victim][1:] < 2 * SERVICE)
+
+
+# ---------------------------------------------------------------------------
+# Circuit-breaker recovery: flap safety (ISSUE 19 tentpole)
+
+
+class _StubProbeSearcher:
+    """shadow_probe stand-in: scripted per-probe latencies (an Exception
+    entry raises instead)."""
+
+    def __init__(self, latencies=(), default=0.001):
+        self.script = list(latencies)
+        self.default = default
+        self.calls = 0
+
+    def shadow_probe(self, rank, queries, k):
+        self.calls += 1
+        lat = self.script.pop(0) if self.script else self.default
+        if isinstance(lat, Exception):
+            raise lat
+        return lat
+
+
+class TestRecoveryBreaker:
+    def _prober(self, health, latencies=(), **kw):
+        from raft_tpu.serve import RecoveryProber
+
+        stub = _StubProbeSearcher(latencies)
+        kw.setdefault("clean_threshold", 3)
+        return RecoveryProber(stub, health,
+                              np.zeros((1, 4), np.float32), 4, **kw), stub
+
+    def test_validation(self):
+        from raft_tpu.serve import RecoveryProber
+
+        h = ShardHealth(2)
+        with pytest.raises(LogicError):
+            RecoveryProber(_StubProbeSearcher(), h,
+                           np.zeros((1, 4), np.float32), 4,
+                           clean_threshold=0)
+        with pytest.raises(LogicError):
+            RecoveryProber(_StubProbeSearcher(), h,
+                           np.zeros((1, 4), np.float32), 4, budget=-1.0)
+        with pytest.raises(LogicError):
+            RecoveryProber(_StubProbeSearcher(), h,
+                           np.zeros(4, np.float32), 4)
+
+    def test_slow_probe_resets_streak_no_half_credit(self):
+        h = ShardHealth(2)
+        h.mark_dead(1)
+        prober, stub = self._prober(
+            h, latencies=[0.001, 0.9, 0.001, 0.001, 0.001], budget=0.1)
+        assert prober.state(1) == "open"
+        assert prober.step() == []            # clean: streak 1
+        assert prober.state(1) == "half_open"
+        assert prober.step() == []            # SLOW: streak voided
+        assert prober.state(1) == "open"
+        assert not h.is_live(1)               # flapper never served
+        assert prober.step() == []
+        assert prober.step() == []
+        assert not h.is_live(1)               # still only 2 clean in a row
+        assert prober.step() == [1]           # 3rd consecutive clean
+        assert h.state(1) == "live"
+        assert prober.state(1) == "closed"
+        snap = prober.snapshot()
+        assert snap["probes_sent"] == 5
+        assert snap["probes_clean"] == 4
+        assert snap["readmissions"] == 1
+        assert snap["streaks"][1] == 0        # spent on the readmission
+        prober.close()
+        prober.close()                        # idempotent
+
+    def test_probe_exception_is_dirty(self):
+        h = ShardHealth(2)
+        h.mark_dead(1)
+        prober, _ = self._prober(
+            h, latencies=[0.001, InjectedFault("probe lost"), 0.001,
+                          0.001, 0.001])
+        prober.step()
+        prober.step()                         # raises inside: streak 0
+        assert prober.state(1) == "open"
+        prober.step()
+        prober.step()
+        assert prober.step() == [1]
+        assert prober.snapshot()["probes_clean"] == 4
+        prober.close()
+
+    def test_transition_between_steps_voids_streak(self):
+        """A fresh dead edge BETWEEN probing passes restarts the proof
+        (the prober subscribes to the state-listener feed)."""
+        h = ShardHealth(2)
+        h.mark_dead(1)
+        prober, _ = self._prober(h)
+        prober.step()
+        prober.step()
+        assert prober.state(1) == "half_open"
+        h.mark_live(1)                        # operator flap …
+        h.mark_dead(1)                        # … and it dies again
+        assert prober.state(1) == "open"      # no credit survives
+        prober.step()
+        prober.step()
+        assert not h.is_live(1)
+        assert prober.step() == [1]
+        prober.close()
+
+    def test_suspect_rank_probed_back_to_closed(self):
+        h = ShardHealth(2, latency=_lat_policy())
+        h.mark_suspect(1)
+        prober, stub = self._prober(h)
+        assert prober.state(1) == "open"
+        prober.step()
+        prober.step()
+        assert prober.step() == [1]
+        assert h.state(1) == "live" and not h.is_suspect(1)
+        assert stub.calls == 3                # live rank 0 never probed
+        prober.close()
+
+    def test_breaker_on_real_searcher_with_scripted_flap(
+            self, straggler_setup, mesh4):
+        """End to end on the routed searcher: a slow shadow probe
+        (chaos delay) voids the streak; re-admission takes exactly
+        clean_threshold consecutive clean probes."""
+        from raft_tpu.serve import RecoveryProber
+
+        setup = straggler_setup
+        victim = setup["victim"]
+        s, health, clock, monkey = _straggler_serving(setup, mesh4,
+                                                      hedged=True)
+        rng = np.random.default_rng(5)
+        health.mark_dead(victim)
+        prober = RecoveryProber(s, health,
+                                _rank_queries(setup, rng, victim),
+                                10, clean_threshold=3,
+                                budget=5 * SERVICE)
+        monkey.script("serve.dispatch", [FaultSpec(
+            kind="delay", at=(1,), rank=victim, seconds=10 * SERVICE)])
+        assert prober.step() == []            # probe 0: clean
+        assert prober.step() == []            # probe 1: scripted flap
+        assert prober.state(victim) == "open"
+        assert prober.step() == []
+        assert prober.step() == []
+        assert not health.is_live(victim)
+        assert prober.step() == [victim]
+        assert health.state(victim) == "live"
+        assert prober.snapshot()["probes_sent"] == 5
+        # probe latencies are shadow traffic: they never feed the
+        # latency-health registry (no EWMA for the probed rank)
+        assert np.isnan(health.latency_ewma(victim))
+        prober.close()
+
+
+# ---------------------------------------------------------------------------
+# Sanitized lane: re-admission compiles nothing, transfers nothing
+
+
+@pytest.mark.sanitized
+def test_breaker_readmission_steady_state(mesh4, sanitizer_lane):
+    """Acceptance: dead-shard serving, the recovery probes, the
+    mark_live re-admission and post-recovery serving all reuse warmed
+    traces — zero steady-state compiles, zero implicit transfers."""
+    from raft_tpu.neighbors import ivf_flat
+    from raft_tpu.parallel import (sharded_ivf_flat_build,
+                                   sharded_replicate_lists)
+    from raft_tpu.serve import BucketGrid, RecoveryProber, Searcher, warmup
+
+    rng = np.random.default_rng(23)
+    with sanitizer_lane.allow_transfers():     # builds are control-plane
+        db = rng.normal(size=(1024, 16)).astype(np.float32)
+        params = ivf_flat.IndexParams(n_lists=8, kmeans_n_iters=4)
+        index = sharded_ivf_flat_build(mesh4, params, db,
+                                       placement="list")
+        index = sharded_replicate_lists(mesh4, index, [0, 1])
+    clock = FakeClock()
+    health = ShardHealth(4)
+    s = Searcher.ivf_flat(index, ivf_flat.SearchParams(n_probes=8),
+                          mesh=mesh4, health=health,
+                          monotonic=clock.monotonic)
+    grid = BucketGrid(q_buckets=(8,), k_grid=(5,))
+    warmup(s, grid)
+    q = rng.normal(size=(8, 16)).astype(np.float32)
+    s.search(q, 5)
+    victim = 1
+    health.mark_dead(victim)
+    s.search(q, 5)                 # degraded routing: same warmed ladder
+    prober = RecoveryProber(s, health, q, 5, clean_threshold=3)
+    sanitizer_lane.mark_steady()
+
+    while health.state(victim) != "live":
+        prober.step()              # shadow probes ride warmed traces
+    res = s.search(q, 5)           # full-fleet serving after re-admission
+    assert res.indices.shape == (8, 5)
+    assert float(res.coverage.min()) == 1.0
+    assert sanitizer_lane.steady_compiles == 0
+    prober.close()

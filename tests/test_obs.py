@@ -1159,3 +1159,108 @@ class TestDurabilityCollectors:
         reg2 = MetricsRegistry()
         ElasticCollector(reg2, stats=ElasticStats())
         assert "raft_elastic_joins_total 0" in reg2.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# Tail-robustness collectors (ISSUE 19): suspect health, hedge,
+# breaker, degradation ladder
+
+
+class TestRobustnessCollectors:
+    def test_shard_health_suspect_gauge_and_state_transitions(self):
+        from raft_tpu.comms.health import LatencyPolicy
+        from raft_tpu.obs import ShardHealthCollector
+
+        health = ShardHealth(4, latency=LatencyPolicy())
+        reg = MetricsRegistry()
+        col = ShardHealthCollector(reg, health)
+        health.mark_suspect(1)
+        text = reg.prometheus_text()
+        assert 'raft_shard_suspect{rank="1"} 1' in text
+        assert 'raft_shard_suspect{rank="0"} 0' in text
+        assert 'raft_shard_live{rank="1"} 1' in text   # suspect != dead
+        assert 'raft_shard_n_suspect 1' in text
+        assert 'raft_shard_n_live 4' in text
+        # suspect edges are invisible to the binary transition counter
+        # but land on the three-state feed
+        assert ('raft_shard_state_transitions_total'
+                '{rank="1",to="suspect"} 1') in text
+        assert 'raft_shard_transitions_total{rank="1"' not in text
+        health.mark_live(1)                 # re-admission between scrapes
+        text = reg.prometheus_text()
+        assert 'raft_shard_suspect{rank="1"} 0' in text
+        assert ('raft_shard_state_transitions_total'
+                '{rank="1",to="live"} 1') in text
+        col.close()
+        health.mark_suspect(2)              # after close: not counted
+        assert ('raft_shard_state_transitions_total{rank="2"'
+                not in reg.prometheus_text())
+
+    def test_hedge_collector_scrape_surface(self):
+        from raft_tpu.obs import HedgeCollector
+        from raft_tpu.serve.hedge import HedgeStats
+
+        class _S:
+            hedge_stats = HedgeStats()
+
+        s = _S()
+        s.hedge_stats.record(fired=True, won=True)
+        s.hedge_stats.record(suppressed=True)
+        reg = MetricsRegistry()
+        HedgeCollector(reg, s)
+        text = reg.prometheus_text()
+        assert "raft_hedge_fired_total 1" in text
+        assert "raft_hedge_won_total 1" in text
+        assert "raft_hedge_suppressed_total 1" in text
+
+    def test_breaker_collector_scrape_surface(self):
+        from raft_tpu.obs import BreakerCollector
+        from raft_tpu.serve import RecoveryProber
+
+        class _Stub:
+            def shadow_probe(self, rank, queries, k):
+                return 0.001
+
+        health = ShardHealth(2)
+        health.mark_dead(1)
+        prober = RecoveryProber(_Stub(), health,
+                                np.zeros((1, 4), np.float32), 4,
+                                clean_threshold=3)
+        reg = MetricsRegistry()
+        BreakerCollector(reg, prober)
+        text = reg.prometheus_text()
+        assert 'raft_breaker_state{rank="0"} 0' in text   # closed
+        assert 'raft_breaker_state{rank="1"} 2' in text   # open
+        prober.step()
+        text = reg.prometheus_text()
+        assert 'raft_breaker_state{rank="1"} 1' in text   # half_open
+        assert 'raft_breaker_clean_streak{rank="1"} 1' in text
+        prober.step()
+        prober.step()                                     # re-admitted
+        text = reg.prometheus_text()
+        assert 'raft_breaker_state{rank="1"} 0' in text
+        assert "raft_breaker_probes_total 3" in text
+        assert "raft_breaker_probes_clean_total 3" in text
+        assert "raft_breaker_readmissions_total 1" in text
+        prober.close()
+
+    def test_degrade_collector_scrape_surface(self, mesh4, db):
+        from raft_tpu.obs import DegradeCollector
+        from raft_tpu.serve import BatchPolicy, BatchScheduler, BucketGrid
+
+        s = Searcher.brute_force(db, mesh=mesh4)
+        sched = BatchScheduler(
+            s, BucketGrid.pow2(8, k_grid=(5, 10)),
+            BatchPolicy(max_batch=8, max_wait=10.0, max_queue=10),
+            clock=lambda: 0.0)
+        reg = MetricsRegistry()
+        DegradeCollector(reg, sched)
+        text = reg.prometheus_text()
+        assert "raft_degrade_brownout_level 0" in text
+        assert "raft_degrade_queue_fill 0" in text
+        sched.submit(np.zeros((1, DIM), np.float32), 5)
+        sched.brownout_level = 2            # what a brownout dispatch sets
+        text = reg.prometheus_text()
+        assert "raft_degrade_brownout_level 2" in text
+        assert "raft_degrade_queue_fill 0.1" in text
+        sched.run_until_idle()

@@ -715,3 +715,308 @@ def test_bench_serve_family_smoke(capsys):
     # The 30%-repeat stream must actually hit (a saturation drive that
     # checks every submit against a still-empty cache reads ~0).
     assert recs["serve_cache_hit_rate"]["value"] > 0.1
+
+
+# ---------------------------------------------------------------------------
+# Deadline degradation ladder + priority shed (ISSUE 19)
+
+
+def _ivf_searcher(db, n_probes=8, n_lists=8):
+    params = ivf_flat.IndexParams(n_lists=n_lists, kmeans_n_iters=4)
+    return Searcher.ivf_flat(ivf_flat.build(params, db),
+                             ivf_flat.SearchParams(n_probes=n_probes))
+
+
+class _CostModelSearcher:
+    """Delegating proxy whose search() advances the injected clock
+    proportionally to the probe depth actually dispatched — the latency
+    model that makes 'fewer probes = faster' observable on the
+    scheduler's own clock."""
+
+    def __init__(self, inner, clock, per_probe):
+        self._inner = inner
+        self._clock = clock
+        self._per_probe = per_probe
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def search(self, queries, k, **kw):
+        npr = kw.get("n_probes") or self._inner._params.n_probes
+        self._clock.advance(self._per_probe * int(npr))
+        return self._inner.search(queries, k, **kw)
+
+
+class TestDegradeLadder:
+    def _policy(self, **kw):
+        from raft_tpu.serve import DegradePolicy
+
+        return DegradePolicy(**kw)
+
+    def test_policy_validation_and_rungs(self):
+        from raft_tpu.serve import DegradePolicy
+
+        with pytest.raises(LogicError):
+            DegradePolicy(ladder=(1.0,))            # need >= 2 rungs
+        with pytest.raises(LogicError):
+            DegradePolicy(ladder=(0.5, 0.25))       # rung 0 must be full
+        with pytest.raises(LogicError):
+            DegradePolicy(ladder=(1.0, 0.5, 0.5))   # strictly descending
+        with pytest.raises(LogicError):
+            DegradePolicy(queue_high=0.9, queue_full=0.5)
+        dp = DegradePolicy(ladder=(1.0, 0.5, 0.25), min_probes=2)
+        assert dp.probes_at(32, 0) == 32
+        assert dp.probes_at(32, 1) == 16
+        assert dp.probes_at(32, 2) == 8
+        assert dp.probes_at(4, 2) == 2              # min_probes floor
+        assert dp.quality_at(0) == "full"
+        assert dp.quality_at(1) == "reduced"
+        assert dp.quality_at(2) == "brownout"
+
+    def test_queue_pressure_walks_the_ladder(self, db):
+        """queue_high forces rung 1 (reduced), queue_full the deepest
+        rung (brownout); once the queue drains, quality returns to
+        full — and the reduced answer equals a direct reduced-depth
+        search (the rung only shrinks n_probes, never corrupts)."""
+        s = _ivf_searcher(db)
+        clock = Clock()
+        grid = BucketGrid.pow2(8, k_grid=(5, 10))
+        sched = BatchScheduler(
+            s, grid, BatchPolicy(max_batch=8, max_wait=10.0, max_queue=8),
+            stats=ServeStats(), clock=clock,
+            degrade=self._policy(queue_high=0.25, queue_full=0.8,
+                                 min_samples=4))
+        rng = np.random.default_rng(41)
+        q8 = make_queries(rng, 8)
+        tA = sched.submit(q8, 5)                    # ripe (rows==max_batch)
+        backlog = [sched.submit(make_queries(rng, 1), 10)
+                   for _ in range(3)]               # young, unripe
+        sched.pump()
+        assert tA.done and not backlog[0].done
+        resA = tA.result()
+        assert resA.quality == "reduced"
+        assert resA.degrade_reason == "queue_pressure"
+        assert sched.brownout_level == 1
+        # rung 1 of base 8 = 4 probes: bitwise-identical to a direct
+        # reduced-depth search of the same batch
+        direct = s.search(q8, 5, n_probes=4)
+        np.testing.assert_array_equal(resA.indices, direct.indices)
+        # deepen the backlog past queue_full -> deepest rung
+        backlog += [sched.submit(make_queries(rng, 1), 10)
+                    for _ in range(4)]              # 7 queued
+        tB = sched.submit(q8, 5)
+        sched.pump()
+        assert tB.result().quality == "brownout"
+        assert tB.result().degrade_reason == "queue_pressure"
+        assert sched.brownout_level == 2
+        # pressure gone: the backlog itself serves at full quality
+        clock.advance(11.0)
+        sched.run_until_idle()
+        for t in backlog:
+            assert t.result().quality == "full"
+            assert t.result().degrade_reason is None
+        assert sched.brownout_level == 0
+        snap = sched.stats.snapshot()["buckets"]
+        assert snap["8x5"]["probes_shrunk"] == 2
+        assert snap["8x5"]["served_reduced"] == 1
+        assert snap["8x5"]["served_brownout"] == 1
+        assert snap["1x10"]["served_full"] == 7
+
+    def test_deadline_budget_picks_the_rung_that_fits(self, db):
+        """The latency model (per-bucket quantile) vs the tightest
+        member deadline: the shallowest rung whose scaled latency fits
+        serves; when nothing fits, the deepest rung serves anyway —
+        degrade before drop."""
+        s = _ivf_searcher(db)
+        clock = Clock()
+        sched = BatchScheduler(
+            s, BucketGrid.pow2(8, k_grid=(5, 10)),
+            BatchPolicy(max_batch=8, max_wait=0.01, max_queue=64),
+            stats=ServeStats(), clock=clock,
+            degrade=self._policy(min_samples=4))
+        for _ in range(8):                  # teach the model: full ~0.1s
+            sched.stats.observe_latency((4, 5), 0.10)
+        rng = np.random.default_rng(43)
+        t = sched.submit(make_queries(rng, 4), 5,
+                         deadline=clock.now + 0.03)
+        sched.flush()
+        # 0.1 > 0.03, 0.05 > 0.03, 0.025 <= 0.03 -> rung 2
+        assert t.result().quality == "brownout"
+        assert t.result().degrade_reason == "deadline_budget"
+        # nothing fits: still served (deepest rung), never dropped
+        t2 = sched.submit(make_queries(rng, 4), 5,
+                          deadline=clock.now + 1e-4)
+        sched.flush()
+        assert t2.result().quality == "brownout"
+        assert t2.result().indices.shape == (4, 5)
+
+    def test_ladder_cuts_deadline_misses_at_equal_shed(self, db):
+        """Acceptance: same request stream, same deadlines, same shed
+        count — the ladder's deadline-miss rate is strictly lower than
+        serving everything at full depth."""
+        def run_stream(with_ladder):
+            clock = Clock()
+            inner = _ivf_searcher(db)
+            s = _CostModelSearcher(inner, clock, per_probe=0.01)
+            sched = BatchScheduler(
+                s, BucketGrid.pow2(8, k_grid=(5, 10)),
+                BatchPolicy(max_batch=8, max_wait=0.01, max_queue=64),
+                stats=ServeStats(), clock=clock,
+                degrade=(self._policy(min_samples=4)
+                         if with_ladder else None))
+            for _ in range(8):              # full depth observed ~0.08s
+                sched.stats.observe_latency((4, 5), 0.08)
+            rng = np.random.default_rng(47)
+            reasons = []
+            for _ in range(10):
+                t = sched.submit(make_queries(rng, 4), 5,
+                                 deadline=clock.now + 0.05)
+                sched.flush()
+                reasons.append(t.result().degrade_reason)
+            agg = {"deadline_misses": 0, "shed": 0}
+            for b in sched.stats.snapshot()["buckets"].values():
+                for key in agg:
+                    agg[key] += b[key]
+            return agg, reasons
+
+        with_ladder, reasons = run_stream(True)
+        without, _ = run_stream(False)
+        assert with_ladder["shed"] == without["shed"] == 0
+        assert with_ladder["deadline_misses"] < without["deadline_misses"]
+        assert without["deadline_misses"] == 10
+        assert with_ladder["deadline_misses"] == 0
+        assert all(r == "deadline_budget" for r in reasons)
+
+    def test_min_probes_floor_noop_shrink_serves_full(self, db):
+        """When the min_probes floor makes a rung's shrink a no-op, the
+        batch serves (and is labeled) full — no fake brownout."""
+        s = _ivf_searcher(db, n_probes=2)
+        clock = Clock()
+        sched = BatchScheduler(
+            s, BucketGrid.pow2(8, k_grid=(5, 10)),
+            BatchPolicy(max_batch=8, max_wait=10.0, max_queue=8),
+            stats=ServeStats(), clock=clock,
+            degrade=self._policy(ladder=(1.0, 0.5), min_probes=2,
+                                 queue_high=0.25, min_samples=4))
+        rng = np.random.default_rng(53)
+        t = sched.submit(make_queries(rng, 8), 5)
+        backlog = [sched.submit(make_queries(rng, 1), 10)
+                   for _ in range(3)]                 # fill 0.375 >= high
+        sched.pump()
+        assert t.result().quality == "full"
+        assert t.result().degrade_reason is None
+        assert sched.brownout_level == 0
+        snap = sched.stats.snapshot()["buckets"]
+        assert snap["8x5"]["probes_shrunk"] == 0
+        clock.advance(11.0)
+        sched.run_until_idle()
+        assert all(b.done for b in backlog)
+
+    def test_reduced_probe_answers_never_cached(self, db):
+        s = _ivf_searcher(db)
+        clock = Clock()
+        cache = ResultCache(32)
+        grid = BucketGrid.pow2(8, k_grid=(5, 10))
+        sched = BatchScheduler(
+            s, grid, BatchPolicy(max_batch=8, max_wait=10.0, max_queue=8),
+            cache=cache, stats=ServeStats(), clock=clock,
+            degrade=self._policy(queue_high=0.25, min_samples=4))
+        rng = np.random.default_rng(59)
+        q = make_queries(rng, 8)
+        sched.submit(q, 5)
+        backlog = [sched.submit(make_queries(rng, 1), 10)
+                   for _ in range(3)]
+        sched.pump()
+        assert len(cache) == 0          # reduced answer not cached
+        clock.advance(11.0)
+        sched.run_until_idle()          # drain (full-quality answers cache)
+        t = sched.submit(q, 5)          # re-ask at full quality
+        sched.flush()
+        assert t.result().quality == "full"
+        assert len(cache) > 0           # full answer cached now
+        assert sched.stats.snapshot()["buckets"]["8x5"]["cache_hits"] == 0
+
+    def test_priority_eviction_low_sheds_before_high(self, db, mesh4):
+        """A full queue evicts the youngest member of the lowest
+        priority class only when the newcomer strictly outranks it;
+        uniform priorities shed the newcomer (the PR-9 behavior)."""
+        s = Searcher.brute_force(db, mesh=mesh4)
+        clock = Clock()
+        sched = make_sched(s, clock=clock, max_queue=2, max_wait=10.0)
+        rng = np.random.default_rng(61)
+        t_old = sched.submit(make_queries(rng, 1), 5, priority=0)
+        clock.advance(0.001)
+        t_young = sched.submit(make_queries(rng, 1), 5, priority=0)
+        # eviction order within the lowest class: youngest first (least
+        # sunk queue-wait)
+        t_hi1 = sched.submit(make_queries(rng, 1), 5, priority=1)
+        assert t_young.done and not t_old.done
+        with pytest.raises(Overloaded):
+            t_young.result()
+        t_hi2 = sched.submit(make_queries(rng, 1), 5, priority=1)
+        assert t_old.done                   # remaining low class evicted
+        with pytest.raises(Overloaded):
+            t_old.result()
+        # uniform priorities: the newcomer sheds, equal rank never evicts
+        with pytest.raises(Overloaded):
+            sched.submit(make_queries(rng, 1), 5, priority=1)
+        # a LOWER-priority newcomer sheds immediately too
+        with pytest.raises(Overloaded):
+            sched.submit(make_queries(rng, 1), 5, priority=0)
+        sched.run_until_idle()
+        assert t_hi1.result().indices.shape == (1, 5)
+        assert t_hi2.result().indices.shape == (1, 5)
+        agg = {"shed": 0, "priority_evictions": 0}
+        for b in sched.stats.snapshot()["buckets"].values():
+            for key in agg:
+                agg[key] += b[key]
+        assert agg["priority_evictions"] == 2
+        assert agg["shed"] == 4             # 2 evictions + 2 newcomers
+
+    def test_warmup_degrade_ladder_precompiles_rungs(self, db):
+        """n_probes is a jit STATIC: every ladder rung warmup compiled
+        serves without a single steady-state compile."""
+        s = _ivf_searcher(db)
+        grid = BucketGrid(q_buckets=(8,), k_grid=(5,))
+        report = warmup(s, grid, degrade_ladder=(1.0, 0.5, 0.25))
+        assert report["degrade_rungs"] == 2       # 4 and 2 (base 8)
+        rng = np.random.default_rng(67)
+        q = make_queries(rng, 8)
+        with CompileCounter() as counter:
+            s.search(q, 5)
+            s.search(q, 5, n_probes=4)
+            s.search(q, 5, n_probes=2)
+        assert counter.count == 0
+
+
+def test_bench_degrade_family_smoke(capsys):
+    """Keeps bench/degrade.py from rotting (same contract as the serve
+    bench smoke) and doubles as the acceptance sweep: hedged mode holds
+    coverage 1.0 with a winning hedge while unhedged p99 tracks the
+    straggler; the breaker re-admits in exactly clean_threshold probes."""
+    import json
+
+    from bench.degrade import run
+
+    run(quick=True)
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    straggler = {}
+    recs = {}
+    for line in lines:
+        rec = json.loads(line)
+        recs.setdefault(rec["metric"], []).append(rec)
+        if rec["metric"] == "degrade_straggler_p99_ms":
+            straggler[rec["mode"]] = rec
+    assert {"degrade_straggler_p99_ms", "degrade_rung_recall",
+            "degrade_rung_latency_ms", "degrade_breaker_readmit_probes",
+            "degrade_breaker_readmit_s"} <= set(recs)
+    assert set(straggler) == {"healthy", "unhedged", "hedged"}
+    assert straggler["unhedged"]["value"] > 5 * straggler["healthy"]["value"]
+    hedged = straggler["hedged"]
+    assert hedged["coverage_min"] == 1.0
+    assert hedged["won"] >= 1 and hedged["n_suspect"] == 1
+    for rec in recs["degrade_rung_recall"]:
+        assert rec["value"] > 0.5
+    breaker = recs["degrade_breaker_readmit_probes"][0]
+    assert breaker["readmitted"] is True
+    assert breaker["value"] == breaker["clean_threshold"] == 3
